@@ -1,0 +1,102 @@
+"""apex_tpu.amp — mixed precision: policies + dynamic loss scaling.
+
+≡ apex.amp (apex/amp/frontend.py) + apex.fp16_utils, re-designed for
+XLA: no op monkey-patching; an explicit `Policy` applied at call sites,
+a pure-functional `LossScaler` state, and master-weight helpers.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax.numpy as jnp
+
+from apex_tpu.amp import scaler
+from apex_tpu.amp.policy import (
+    FP32_CLASS_OPS,
+    MATMUL_CLASS_OPS,
+    Policy,
+    convert_network,
+    get_policy,
+    master_params_to_model_params,
+    model_grads_to_master_grads,
+    prep_param_lists,
+)
+from apex_tpu.amp.scaler import LossScalerState
+
+__all__ = [
+    "Policy", "get_policy", "initialize", "AmpState", "scaler",
+    "LossScalerState", "convert_network", "prep_param_lists",
+    "model_grads_to_master_grads", "master_params_to_model_params",
+    "MATMUL_CLASS_OPS", "FP32_CLASS_OPS", "state_dict", "load_state_dict",
+]
+
+
+@dataclasses.dataclass
+class AmpState:
+    """Bundle of policy + per-loss scaler states ≡ _amp_state
+    (apex/amp/_amp_state.py:16) minus the global mutability."""
+
+    policy: Policy
+    loss_scalers: list  # one LossScalerState per loss (frontend.py:229-233)
+
+    @property
+    def dynamic(self) -> bool:
+        return self.policy.loss_scale == "dynamic"
+
+
+def initialize(params=None, opt_level: str = "O1", num_losses: int = 1,
+               low_dtype=jnp.bfloat16, **overrides):
+    """≡ apex.amp.initialize (apex/amp/frontend.py:197-404).
+
+    Returns (cast_params, AmpState).  O2/O3 cast the param pytree
+    (keeping norm params fp32 under O2, ≡ _initialize.py:178-184); O0/O1
+    leave params fp32.  `num_losses` scalers are created
+    (≡ _initialize.py:229-233).
+    """
+    policy = get_policy(opt_level, low_dtype=low_dtype, **overrides)
+    if params is not None and policy.param_dtype != jnp.float32:
+        if policy.keep_norm_fp32:
+            params = convert_network(params, policy.param_dtype)
+        else:
+            params = policy.cast_to_param(params)
+    scalers = [scaler.init(policy.loss_scale) for _ in range(num_losses)]
+    state = AmpState(policy=policy, loss_scalers=scalers)
+    if params is None:
+        return state
+    return params, state
+
+
+def scale_loss(state: AmpState, loss, loss_id: int = 0):
+    """≡ the `with amp.scale_loss(...)` entry (apex/amp/handle.py:16-113)."""
+    return scaler.scale_loss(state.loss_scalers[loss_id], loss)
+
+
+def unscale_and_update(state: AmpState, grads, loss_id: int = 0):
+    """Unscale grads, check overflow, update the scaler state.
+
+    ≡ ctx-manager exit: LossScaler.unscale + update_scale
+    (apex/amp/handle.py:118-154, scaler.py:105-217).  Returns
+    (unscaled_grads, found_inf, new_state); the caller masks the
+    optimizer update with found_inf.
+    """
+    s = state.loss_scalers[loss_id]
+    grads, found_inf = scaler.unscale(s, grads)
+    new_s = scaler.update(s, found_inf, dynamic=state.dynamic)
+    scalers = list(state.loss_scalers)
+    scalers[loss_id] = new_s
+    return grads, found_inf, AmpState(policy=state.policy, loss_scalers=scalers)
+
+
+def state_dict(state: AmpState) -> dict:
+    """≡ apex.amp.state_dict (frontend.py:365-384)."""
+    return {f"loss_scaler{i}": scaler.state_dict(s)
+            for i, s in enumerate(state.loss_scalers)}
+
+
+def load_state_dict(state: AmpState, d: dict) -> AmpState:
+    """≡ apex.amp.load_state_dict (frontend.py:387-404)."""
+    scalers = [scaler.load_state_dict(d[f"loss_scaler{i}"])
+               for i in range(len(state.loss_scalers))]
+    return AmpState(policy=state.policy, loss_scalers=scalers)

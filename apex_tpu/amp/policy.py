@@ -1,0 +1,162 @@
+"""Mixed-precision policies — TPU-native `apex.amp` opt levels.
+
+The reference's AMP is an op-patching engine: O1 monkey-patches torch
+namespaces with cast wrappers driven by allow/deny lists
+(apex/amp/frontend.py:104-193, apex/amp/lists/torch_overrides.py:7-115),
+O2/O3 cast the whole model (apex/amp/_initialize.py:178-203).  JAX traces
+pure functions, so op interception is impossible and unnecessary: a
+*policy* object states param/compute/output dtypes and is applied
+explicitly at module call sites.  The cast lists become the behavioral
+contract encoded in `MATMUL_CLASS_OPS` / `FP32_CLASS_OPS` below: under
+O1 only matmul-class compute runs in low precision, while
+reduction/loss/norm-class ops stay fp32 — the same split as the
+reference's allow list (conv/mm/addmm…) vs promote list
+(softmax/norm/loss, functional_overrides.py:16-80).
+
+On TPU the low-precision dtype defaults to bfloat16: its fp32-sized
+exponent makes loss scaling unnecessary (scaler retained for fp16-parity
+mode, see scaler.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+# Behavioral contract of the reference cast lists (apex/amp/lists/):
+# ops that benefit from low precision (MXU-bound)...
+MATMUL_CLASS_OPS = ("conv", "matmul", "dense", "attention", "mlp", "einsum")
+# ...and ops that must run fp32 (reference "promote"/fp32 lists).
+FP32_CLASS_OPS = (
+    "softmax", "log_softmax", "layer_norm", "batch_norm", "group_norm",
+    "cross_entropy", "mse_loss", "l1_loss", "exp", "log", "pow", "sum",
+    "cumsum", "var", "std", "norm",
+)
+
+
+def _cast_floating(tree, dtype):
+    def cast(x):
+        if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating):
+            return x.astype(dtype)
+        return x
+    return jax.tree_util.tree_map(cast, tree)
+
+
+@dataclasses.dataclass(frozen=True)
+class Policy:
+    """(param, compute, output) dtype triple.
+
+    ≡ the Properties object produced by apex.amp.frontend.initialize
+    (frontend.py:9-101) — `cast_model_type` → param_dtype, the O1 patched
+    casts → compute_dtype, `cast_model_outputs` → output_dtype.
+    `keep_norm_fp32` ≡ keep_batchnorm_fp32 (frontend.py:129).
+    `master_weights` ≡ master_weights (frontend.py:135).
+    `loss_scale` is "dynamic", None, or a float (frontend.py:139).
+    """
+
+    opt_level: str = "O1"
+    param_dtype: Any = jnp.float32
+    compute_dtype: Any = jnp.float32
+    output_dtype: Any = jnp.float32
+    keep_norm_fp32: bool = True
+    master_weights: bool = False
+    loss_scale: Optional[Any] = None   # None | float | "dynamic"
+
+    # -- casting helpers applied at module call sites ----------------------
+    def cast_to_compute(self, *trees):
+        out = tuple(_cast_floating(t, self.compute_dtype) for t in trees)
+        return out[0] if len(out) == 1 else out
+
+    def cast_to_param(self, *trees):
+        out = tuple(_cast_floating(t, self.param_dtype) for t in trees)
+        return out[0] if len(out) == 1 else out
+
+    def cast_to_output(self, *trees):
+        out = tuple(_cast_floating(t, self.output_dtype) for t in trees)
+        return out[0] if len(out) == 1 else out
+
+    def compute_for(self, op_name: str):
+        """Compute dtype for a named op class, honoring the fp32 list."""
+        if any(k in op_name for k in FP32_CLASS_OPS):
+            return jnp.float32
+        return self.compute_dtype
+
+
+def _mk(opt_level, low=jnp.bfloat16, **kw):
+    presets = {
+        # ≡ apex/amp/frontend.py:104-193 opt_levels table
+        "O0": dict(param_dtype=jnp.float32, compute_dtype=jnp.float32,
+                   output_dtype=jnp.float32, master_weights=False,
+                   loss_scale=1.0),
+        "O1": dict(param_dtype=jnp.float32, compute_dtype=low,
+                   output_dtype=jnp.float32, master_weights=False,
+                   loss_scale="dynamic"),
+        "O2": dict(param_dtype=low, compute_dtype=low,
+                   output_dtype=jnp.float32, master_weights=True,
+                   keep_norm_fp32=True, loss_scale="dynamic"),
+        "O3": dict(param_dtype=low, compute_dtype=low, output_dtype=low,
+                   master_weights=False, keep_norm_fp32=False,
+                   loss_scale=1.0),
+    }
+    cfg = presets[opt_level]
+    cfg.update(kw)
+    return Policy(opt_level=opt_level, **cfg)
+
+
+def get_policy(opt_level: str = "O1", low_dtype=jnp.bfloat16, **overrides) -> Policy:
+    """Look up an O0-O3 preset with keyword overrides.
+
+    ≡ apex.amp.frontend.initialize's opt_level + explicit-override handling
+    (frontend.py:343-356).  On TPU `low_dtype` defaults to bfloat16; pass
+    jnp.float16 for fp16-parity experiments (with dynamic loss scaling).
+    """
+    if opt_level not in ("O0", "O1", "O2", "O3"):
+        raise ValueError(f"Unexpected optimization level {opt_level}")
+    return _mk(opt_level, low=low_dtype, **overrides)
+
+
+# --- fp16_utils equivalents ------------------------------------------------
+
+def convert_network(params, dtype, is_norm_param=None):
+    """Cast a param pytree to `dtype`, keeping norm-layer params fp32.
+
+    ≡ apex.fp16_utils.convert_network / convert_module
+    (apex/fp16_utils/fp16util.py:35-72).  `is_norm_param(path)` decides
+    which leaves stay fp32; the default matches keys containing norm/bn
+    (the reference keys on isinstance(module, _BatchNorm)).
+    """
+    if is_norm_param is None:
+        def is_norm_param(path):
+            p = "/".join(str(k) for k in path).lower()
+            return ("norm" in p) or ("bn" in p) or ("batchstats" in p)
+
+    def cast(path, x):
+        if not (hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating)):
+            return x
+        if is_norm_param(path):
+            return x.astype(jnp.float32)
+        return x.astype(dtype)
+
+    return jax.tree_util.tree_map_with_path(cast, params)
+
+
+def prep_param_lists(params):
+    """(model_params, fp32 master copies) ≡ apex.fp16_utils.prep_param_lists
+    (fp16util.py:92-135) — flat lists become pytrees."""
+    master = _cast_floating(params, jnp.float32)
+    return params, master
+
+
+def model_grads_to_master_grads(model_grads):
+    """≡ fp16util.model_grads_to_master_grads (fp16util.py:138)."""
+    return _cast_floating(model_grads, jnp.float32)
+
+
+def master_params_to_model_params(master_params, model_params):
+    """≡ fp16util.master_params_to_model_params (fp16util.py:160-177)."""
+    return jax.tree_util.tree_map(
+        lambda m, p: m.astype(p.dtype) if hasattr(p, "dtype") else m,
+        master_params, model_params)

@@ -1,0 +1,106 @@
+"""Dynamic loss scaling as a pure function-of-state.
+
+≡ apex.amp.scaler.LossScaler (apex/amp/scaler.py:33-217) and
+apex.fp16_utils.loss_scaler.{LossScaler,DynamicLossScaler}
+(apex/fp16_utils/loss_scaler.py:10,49).  The reference mutates a Python
+object and patches `optimizer.step` to skip on overflow
+(apex/amp/handle.py:128-154); under jit that data-dependent skip becomes
+a `lax.cond`-free masked update: `update()` runs every step and the
+optimizer applies `jnp.where(found_inf, old, new)` (see
+optimizers/fused_adam.py), keeping the whole step on-device with no host
+sync — the TPU analogue of the reference's "capturable" CUDA-graph mode
+(apex/optimizers/fused_adam.py:199-263).
+
+State is a small pytree so it jits, shards, and checkpoints trivially
+(state_dict parity: apex/amp/frontend.py:365-404).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class LossScalerState(NamedTuple):
+    scale: jnp.ndarray            # f32 scalar, current loss scale
+    growth_tracker: jnp.ndarray   # i32 scalar ≡ _unskipped (scaler.py:44)
+    found_inf: jnp.ndarray        # bool scalar, last-step overflow flag
+
+
+def init(loss_scale="dynamic", init_scale=2.0 ** 16) -> LossScalerState:
+    """≡ LossScaler.__init__ (apex/amp/scaler.py:33-60).  A static float
+    disables growth/backoff; "dynamic" starts at 2**16."""
+    if loss_scale == "dynamic":
+        scale = init_scale
+    else:
+        scale = float(loss_scale) if loss_scale is not None else 1.0
+    return LossScalerState(
+        scale=jnp.asarray(scale, jnp.float32),
+        growth_tracker=jnp.zeros((), jnp.int32),
+        found_inf=jnp.zeros((), bool),
+    )
+
+
+def scale_loss(state: LossScalerState, loss):
+    """≡ amp.scale_loss ctx manager entry (apex/amp/handle.py:113):
+    loss.float() * loss_scale."""
+    return loss.astype(jnp.float32) * state.scale
+
+
+def check_finite(grads) -> jnp.ndarray:
+    """Global finite check over a grad pytree ≡ the overflow buffer the
+    multi-tensor unscale kernel sets (apex/amp/scaler.py:105-117).  XLA
+    fuses this reduction into the surrounding step."""
+    leaves = jax.tree_util.tree_leaves(grads)
+    if not leaves:
+        return jnp.zeros((), bool)
+    flags = [~jnp.all(jnp.isfinite(g)) for g in leaves]
+    return jnp.stack(flags).any()
+
+
+def unscale(state: LossScalerState, grads):
+    """(grads / scale, found_inf) ≡ LossScaler.unscale (scaler.py:105-145)."""
+    inv = 1.0 / state.scale
+    unscaled = jax.tree_util.tree_map(lambda g: g * inv.astype(g.dtype), grads)
+    return unscaled, check_finite(grads)
+
+
+def update(state: LossScalerState, found_inf, dynamic: bool = True,
+           growth_interval: int = 2000, growth_factor: float = 2.0,
+           backoff_factor: float = 0.5, min_scale: float = 1.0,
+           max_scale: float = 2.0 ** 24) -> LossScalerState:
+    """≡ LossScaler.update_scale (apex/amp/scaler.py:197-217), branch-free:
+    on overflow scale *= backoff and tracker resets; after
+    `growth_interval` clean steps scale *= growth."""
+    if not dynamic:
+        return state._replace(found_inf=found_inf)
+    tracker = jnp.where(found_inf, 0, state.growth_tracker + 1)
+    grow = tracker >= growth_interval
+    scale = jnp.where(
+        found_inf,
+        jnp.maximum(state.scale * backoff_factor, min_scale),
+        jnp.where(grow, jnp.minimum(state.scale * growth_factor, max_scale),
+                  state.scale),
+    )
+    tracker = jnp.where(grow, 0, tracker)
+    return LossScalerState(scale=scale, growth_tracker=tracker,
+                           found_inf=found_inf)
+
+
+def state_dict(state: LossScalerState) -> dict:
+    """≡ apex.amp.state_dict (apex/amp/frontend.py:365-384)."""
+    return {
+        "loss_scale": jax.device_get(state.scale).item(),
+        "unskipped": jax.device_get(state.growth_tracker).item(),
+    }
+
+
+def load_state_dict(d: dict) -> LossScalerState:
+    """≡ apex.amp.load_state_dict (apex/amp/frontend.py:387-404)."""
+    return LossScalerState(
+        scale=jnp.asarray(d["loss_scale"], jnp.float32),
+        growth_tracker=jnp.asarray(d["unskipped"], jnp.int32),
+        found_inf=jnp.zeros((), bool),
+    )

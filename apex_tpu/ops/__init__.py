@@ -1,0 +1,38 @@
+"""apex_tpu.ops — fused kernels (Pallas on TPU, jnp reference off-TPU).
+
+≡ the reference's native kernel layer (csrc/, apex/contrib/csrc/) plus
+its Python autograd wrappers (apex.normalization, apex.mlp,
+apex.fused_dense, apex.transformer.functional.fused_softmax,
+apex.contrib.{xentropy,focal_loss,index_mul_2d,...}).
+"""
+
+_LAZY = {
+    "layer_norm": "apex_tpu.ops.layer_norm",
+    "softmax": "apex_tpu.ops.softmax",
+    "xentropy": "apex_tpu.ops.xentropy",
+    "focal_loss": "apex_tpu.ops.focal_loss",
+    "mlp": "apex_tpu.ops.mlp",
+    "fused_dense": "apex_tpu.ops.fused_dense",
+    "multi_tensor": "apex_tpu.ops.multi_tensor",
+    "welford": "apex_tpu.ops.welford",
+    "flash_attention": "apex_tpu.ops.flash_attention",
+    "index_mul_2d": "apex_tpu.ops.index_mul_2d",
+    "optimizer_kernels": "apex_tpu.ops.optimizer_kernels",
+}
+
+_SYMBOLS = {
+    "fused_layer_norm": ("apex_tpu.ops.layer_norm", "fused_layer_norm"),
+    "fused_rms_norm": ("apex_tpu.ops.layer_norm", "fused_rms_norm"),
+    "FusedLayerNorm": ("apex_tpu.ops.layer_norm", "FusedLayerNorm"),
+    "FusedRMSNorm": ("apex_tpu.ops.layer_norm", "FusedRMSNorm"),
+}
+
+
+def __getattr__(name):
+    import importlib
+    if name in _LAZY:
+        return importlib.import_module(_LAZY[name])
+    if name in _SYMBOLS:
+        mod, sym = _SYMBOLS[name]
+        return getattr(importlib.import_module(mod), sym)
+    raise AttributeError(name)

@@ -1,0 +1,51 @@
+"""Shared kernel-layer plumbing.
+
+≡ the reference's shared native infrastructure (csrc/type_shim.h dtype
+dispatch, csrc/compat.h): here it is backend dispatch — every fused op
+has a Pallas TPU kernel and a pure-jnp reference implementation; on
+non-TPU backends (CPU tests, interpret mode) the jnp path is used, the
+same way the reference falls back to pure PyTorch when the extension is
+absent (apex/normalization/fused_layer_norm.py:288-294).
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+
+_FORCE = os.environ.get("APEX_TPU_FORCE_PALLAS", "")
+
+
+def use_pallas(override=None) -> bool:
+    """Decide kernel path: Pallas on TPU, jnp reference elsewhere.
+
+    `override`: True → pallas (interpret-mode off-TPU), False → jnp.
+    Env APEX_TPU_FORCE_PALLAS=1/0 wins over the backend default.
+    """
+    if override is not None:
+        return override
+    if _FORCE == "1":
+        return True
+    if _FORCE == "0":
+        return False
+    return jax.default_backend() == "tpu"
+
+
+def pallas_interpret() -> bool:
+    """Pallas kernels run in interpret mode off-TPU (for CPU CI parity)."""
+    return jax.default_backend() != "tpu"
+
+
+def round_up(n: int, m: int) -> int:
+    return ((n + m - 1) // m) * m
+
+
+def row_block(rows: int, hidden: int, bytes_per_elt: int = 4,
+              vmem_budget: int = 2 * 1024 * 1024, align: int = 8,
+              cap: int = 1024) -> int:
+    """Pick a row-block size so a (block, hidden) fp32 tile fits the VMEM
+    budget; aligned to the fp32 sublane (8)."""
+    b = max(align, vmem_budget // max(1, hidden * bytes_per_elt))
+    b = min(b, cap, round_up(rows, align))
+    return round_up(b, align) if b % align else b

@@ -1,0 +1,27 @@
+"""apex_tpu.optimizers — fused optimizers over flat parameter buffers.
+
+≡ apex.optimizers (apex/optimizers/__init__.py): FusedAdam, FusedLAMB,
+FusedSGD, FusedNovoGrad, FusedAdagrad, FusedMixedPrecisionLamb — each a
+single fused Pallas kernel pass over a flattened dtype-partitioned
+buffer, ≡ one multi_tensor_applier launch per dtype group
+(apex/optimizers/fused_adam.py:156-303).
+"""
+
+
+def __getattr__(name):
+    import importlib
+    mods = {
+        "FusedAdam": "apex_tpu.optimizers.fused_adam",
+        "FusedLAMB": "apex_tpu.optimizers.fused_lamb",
+        "FusedSGD": "apex_tpu.optimizers.fused_sgd",
+        "FusedNovoGrad": "apex_tpu.optimizers.fused_novograd",
+        "FusedAdagrad": "apex_tpu.optimizers.fused_adagrad",
+        "FusedMixedPrecisionLamb": "apex_tpu.optimizers.fused_lamb",
+        "DistributedFusedAdam": "apex_tpu.optimizers.distributed_fused_adam",
+    }
+    if name in mods:
+        return getattr(importlib.import_module(mods[name]), name)
+    if name in ("fused_adam", "fused_lamb", "fused_sgd", "fused_novograd",
+                "fused_adagrad", "distributed_fused_adam", "flat"):
+        return importlib.import_module(f"apex_tpu.optimizers.{name}")
+    raise AttributeError(name)

@@ -1,0 +1,50 @@
+"""apex_tpu.parallel — mesh, collectives, and data-parallel utilities.
+
+≡ apex.parallel (apex/parallel/__init__.py) + the process-group layer of
+apex.transformer.parallel_state, re-based on `jax.sharding.Mesh`.
+"""
+
+from apex_tpu.parallel import collectives, mesh
+from apex_tpu.parallel.mesh import (
+    DP_AXIS,
+    PP_AXIS,
+    TP_AXIS,
+    destroy_model_parallel,
+    get_data_parallel_world_size,
+    get_mesh,
+    get_pipeline_model_parallel_world_size,
+    get_rank_info,
+    get_tensor_model_parallel_world_size,
+    initialize_model_parallel,
+    model_parallel_is_initialized,
+    named_sharding,
+)
+
+__all__ = [
+    "mesh", "collectives", "initialize_model_parallel",
+    "destroy_model_parallel", "model_parallel_is_initialized", "get_mesh",
+    "named_sharding", "DP_AXIS", "PP_AXIS", "TP_AXIS", "get_rank_info",
+    "get_data_parallel_world_size", "get_tensor_model_parallel_world_size",
+    "get_pipeline_model_parallel_world_size",
+]
+
+
+def __getattr__(name):
+    # Lazy imports for heavier submodules.
+    if name in ("DistributedDataParallel", "ddp"):
+        from apex_tpu.parallel import ddp as _ddp
+        if name == "ddp":
+            return _ddp
+        return _ddp.DistributedDataParallel
+    if name in ("SyncBatchNorm", "sync_batchnorm"):
+        from apex_tpu.parallel import sync_batchnorm as _sbn
+        if name == "sync_batchnorm":
+            return _sbn
+        return _sbn.SyncBatchNorm
+    if name == "LARC":
+        from apex_tpu.parallel.larc import LARC
+        return LARC
+    if name == "clip_grad":
+        from apex_tpu.parallel import clip_grad
+        return clip_grad
+    raise AttributeError(name)

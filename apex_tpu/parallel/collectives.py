@@ -1,0 +1,154 @@
+"""Autograd-visible collectives — TPU-native `tensor_parallel.mappings`.
+
+The reference defines seven torch.autograd.Functions giving Megatron's
+copy/reduce/scatter/gather semantics around tensor-parallel regions
+(apex/transformer/tensor_parallel/mappings.py:141-268).  Here each is a
+`jax.custom_vjp` over `jax.lax` collectives, to be used **inside
+`shard_map`** over the global mesh where the named axis (default "tp")
+is unmapped (manual).  Under plain pjit, XLA's partitioner makes these
+unnecessary; they exist for the explicit-collective (shard_map) code
+path, where JAX's default transpose rules for psum/all_gather do NOT
+reproduce Megatron's conjugate f/g pairs.
+
+Forward/backward pairs (mappings.py:141-268):
+  copy_to_tensor_model_parallel_region        id      / psum
+  reduce_from_tensor_model_parallel_region    psum    / id
+  scatter_to_tensor_model_parallel_region     split-1 / gather-1
+  gather_from_tensor_model_parallel_region    gather-1/ split-1
+  scatter_to_sequence_parallel_region         split0  / gather0
+  gather_from_sequence_parallel_region        gather0 / reduce_scatter0
+  reduce_scatter_to_sequence_parallel_region  rs0     / gather0
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from apex_tpu.parallel.mesh import TP_AXIS
+
+
+def _psum(x, axis_name):
+    return lax.psum(x, axis_name)
+
+
+def _all_gather(x, axis_name, dim):
+    """Concatenate shards along `dim` ≡ mappings._gather_along_*_dim."""
+    return lax.all_gather(x, axis_name, axis=dim, tiled=True)
+
+
+def _split(x, axis_name, dim):
+    """Keep this rank's slice along `dim` ≡ mappings._split_along_*_dim."""
+    n = lax.axis_size(axis_name)
+    local = x.shape[dim] // n
+    idx = lax.axis_index(axis_name)
+    return lax.dynamic_slice_in_dim(x, idx * local, local, axis=dim)
+
+
+def _reduce_scatter(x, axis_name, dim):
+    """Sum across the axis, each rank keeps its slice along `dim`
+    ≡ mappings._reduce_scatter_along_first_dim."""
+    return lax.psum_scatter(x, axis_name, scatter_dimension=dim, tiled=True)
+
+
+def _make_pair(name, fwd_fn, bwd_fn):
+    """Build a custom_vjp collective with independent fwd/bwd collectives."""
+
+    @functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+    def fn(x, axis_name=TP_AXIS):
+        return fwd_fn(x, axis_name)
+
+    def fn_fwd(x, axis_name):
+        return fwd_fn(x, axis_name), None
+
+    def fn_bwd(axis_name, _, g):
+        return (bwd_fn(g, axis_name),)
+
+    fn.defvjp(fn_fwd, fn_bwd)
+    fn.__name__ = name
+    return fn
+
+
+_last = -1
+
+copy_to_tensor_model_parallel_region = _make_pair(
+    "copy_to_tensor_model_parallel_region",
+    lambda x, ax: x,
+    lambda g, ax: _psum(g, ax),
+)
+
+reduce_from_tensor_model_parallel_region = _make_pair(
+    "reduce_from_tensor_model_parallel_region",
+    lambda x, ax: _psum(x, ax),
+    lambda g, ax: g,
+)
+
+scatter_to_tensor_model_parallel_region = _make_pair(
+    "scatter_to_tensor_model_parallel_region",
+    lambda x, ax: _split(x, ax, _last),
+    lambda g, ax: _all_gather(g, ax, _last),
+)
+
+gather_from_tensor_model_parallel_region = _make_pair(
+    "gather_from_tensor_model_parallel_region",
+    lambda x, ax: _all_gather(x, ax, _last),
+    lambda g, ax: _split(g, ax, _last),
+)
+
+scatter_to_sequence_parallel_region = _make_pair(
+    "scatter_to_sequence_parallel_region",
+    lambda x, ax: _split(x, ax, 0),
+    lambda g, ax: _all_gather(g, ax, 0),
+)
+
+# tensor_parallel_output_grad=True variant (mappings.py:232-247): backward
+# is a reduce-scatter because the downstream TP region produces
+# partial-sum gradients on every rank.
+gather_from_sequence_parallel_region = _make_pair(
+    "gather_from_sequence_parallel_region",
+    lambda x, ax: _all_gather(x, ax, 0),
+    lambda g, ax: _reduce_scatter(g, ax, 0),
+)
+
+# tensor_parallel_output_grad=False variant: backward is a plain split.
+gather_from_sequence_parallel_region_no_tp_grad = _make_pair(
+    "gather_from_sequence_parallel_region_no_tp_grad",
+    lambda x, ax: _all_gather(x, ax, 0),
+    lambda g, ax: _split(g, ax, 0),
+)
+
+reduce_scatter_to_sequence_parallel_region = _make_pair(
+    "reduce_scatter_to_sequence_parallel_region",
+    lambda x, ax: _reduce_scatter(x, ax, 0),
+    lambda g, ax: _all_gather(g, ax, 0),
+)
+
+
+def ring_exchange(x, axis_name, shift=1):
+    """Neighbour exchange over a ring ≡ the reference's halo-exchange NCCL
+    p2p (apex/contrib/csrc/nccl_p2p/nccl_p2p.cpp:20-24) — on TPU a single
+    `ppermute` riding ICI."""
+    n = lax.axis_size(axis_name)
+    perm = [(i, (i + shift) % n) for i in range(n)]
+    return lax.ppermute(x, axis_name, perm)
+
+
+def halo_exchange_1d(x, axis_name, halo: int, dim: int = 0):
+    """Exchange `halo`-wide boundary slabs with both ring neighbours along
+    `dim` ≡ PeerHaloExchanger1d (apex/contrib/peer_memory/peer_halo_exchanger_1d.py:5)
+    and HaloExchangerSendRecv (apex/contrib/bottleneck/halo_exchangers.py:60).
+
+    Returns (left_halo, right_halo): the slabs received from the previous /
+    next rank, to be concatenated by the caller (spatial-parallel conv).
+    """
+    n = lax.axis_size(axis_name)
+    top = lax.slice_in_dim(x, 0, halo, axis=dim)
+    bot = lax.slice_in_dim(x, x.shape[dim] - halo, x.shape[dim], axis=dim)
+    fwd = [(i, (i + 1) % n) for i in range(n)]
+    bwd = [(i, (i - 1) % n) for i in range(n)]
+    left = lax.ppermute(bot, axis_name, fwd)   # from prev rank
+    right = lax.ppermute(top, axis_name, bwd)  # from next rank
+    return left, right

@@ -1,0 +1,198 @@
+"""Global device-mesh bookkeeping — TPU-native `parallel_state`.
+
+The reference (apex/transformer/parallel_state.py:36-419) maintains a
+registry of torch.distributed process groups for data/tensor/pipeline/
+virtual-pipeline/model/embedding parallelism.  On TPU there are no
+process-group objects: parallel dimensions are *named axes of one
+`jax.sharding.Mesh`*, collectives are emitted by the compiler against
+those axis names, and "groups" become sub-axes.  This module is the
+single place that builds and queries that mesh.
+
+Axis layout follows Megatron rank ordering (tensor-parallel innermost so
+TP collectives ride the fastest ICI links, then data-parallel, pipeline
+outermost):  mesh shape = (pp, dp, tp) over `jax.devices()` in row-major
+order — the same rank→group mapping as parallel_state.py:266-346.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# Canonical axis names.  (dp, pp, tp) mirrors the reference's
+# data-/pipeline-/tensor-parallel groups; "sp" is not a separate axis —
+# Megatron sequence parallelism shards the sequence dim over the tp axis.
+DP_AXIS = "dp"
+PP_AXIS = "pp"
+TP_AXIS = "tp"
+
+_GLOBAL_STATE = None
+
+
+@dataclasses.dataclass
+class _MeshState:
+    mesh: Mesh
+    tensor_model_parallel_size: int
+    pipeline_model_parallel_size: int
+    data_parallel_size: int
+    virtual_pipeline_model_parallel_size: Optional[int] = None
+    # Mutable "current rank" cursors used by host-driven pipeline code,
+    # mirroring the reference's get/set_virtual_pipeline_model_parallel_rank
+    # (parallel_state.py:700-712).
+    virtual_pipeline_model_parallel_rank: int = 0
+    pipeline_model_parallel_split_rank: Optional[int] = None
+
+
+class MeshNotInitializedError(RuntimeError):
+    pass
+
+
+def initialize_model_parallel(
+    tensor_model_parallel_size: int = 1,
+    pipeline_model_parallel_size: int = 1,
+    virtual_pipeline_model_parallel_size: Optional[int] = None,
+    pipeline_model_parallel_split_rank: Optional[int] = None,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build the global (pp, dp, tp) mesh.
+
+    ≡ parallel_state.initialize_model_parallel (parallel_state.py:155-419),
+    with process groups replaced by named mesh axes.  The data-parallel
+    size is inferred as n_devices // (tp * pp), exactly like the
+    reference's `data_parallel_size = world_size // (tp*pp)`
+    (parallel_state.py:242-244).
+    """
+    global _GLOBAL_STATE
+    if devices is None:
+        devices = jax.devices()
+    world_size = len(devices)
+    tp, pp = tensor_model_parallel_size, pipeline_model_parallel_size
+    if world_size % (tp * pp) != 0:
+        raise ValueError(
+            f"world size {world_size} is not divisible by tp({tp}) x pp({pp})"
+        )
+    dp = world_size // (tp * pp)
+    if virtual_pipeline_model_parallel_size is not None and pp < 2:
+        raise ValueError(
+            "virtual pipeline parallelism requires pipeline_model_parallel_size >= 2"
+        )
+    dev_array = np.asarray(devices).reshape(pp, dp, tp)
+    mesh = Mesh(dev_array, (PP_AXIS, DP_AXIS, TP_AXIS))
+    _GLOBAL_STATE = _MeshState(
+        mesh=mesh,
+        tensor_model_parallel_size=tp,
+        pipeline_model_parallel_size=pp,
+        data_parallel_size=dp,
+        virtual_pipeline_model_parallel_size=virtual_pipeline_model_parallel_size,
+        pipeline_model_parallel_split_rank=pipeline_model_parallel_split_rank,
+    )
+    return mesh
+
+
+def model_parallel_is_initialized() -> bool:
+    """≡ parallel_state.model_parallel_is_initialized (parallel_state.py:424)."""
+    return _GLOBAL_STATE is not None
+
+
+def destroy_model_parallel() -> None:
+    """≡ parallel_state.destroy_model_parallel (parallel_state.py:761-792)."""
+    global _GLOBAL_STATE
+    _GLOBAL_STATE = None
+
+
+def _state() -> _MeshState:
+    if _GLOBAL_STATE is None:
+        raise MeshNotInitializedError(
+            "mesh is not initialized; call apex_tpu.parallel.initialize_model_parallel first"
+        )
+    return _GLOBAL_STATE
+
+
+def get_mesh() -> Mesh:
+    return _state().mesh
+
+
+def get_tensor_model_parallel_world_size() -> int:
+    return _state().tensor_model_parallel_size
+
+
+def get_pipeline_model_parallel_world_size() -> int:
+    return _state().pipeline_model_parallel_size
+
+
+def get_data_parallel_world_size() -> int:
+    return _state().data_parallel_size
+
+
+def get_virtual_pipeline_model_parallel_world_size() -> Optional[int]:
+    return _state().virtual_pipeline_model_parallel_size
+
+
+def get_virtual_pipeline_model_parallel_rank() -> int:
+    return _state().virtual_pipeline_model_parallel_rank
+
+
+def set_virtual_pipeline_model_parallel_rank(rank: int) -> None:
+    _state().virtual_pipeline_model_parallel_rank = rank
+
+
+def get_pipeline_model_parallel_split_rank() -> Optional[int]:
+    return _state().pipeline_model_parallel_split_rank
+
+
+# --- axis_index helpers: valid inside shard_map/pjit over the global mesh ---
+
+def get_tensor_model_parallel_rank():
+    """Per-shard tp coordinate; use inside shard_map (≡ get_tensor_model_parallel_rank)."""
+    return jax.lax.axis_index(TP_AXIS)
+
+
+def get_data_parallel_rank():
+    return jax.lax.axis_index(DP_AXIS)
+
+
+def get_pipeline_model_parallel_rank():
+    return jax.lax.axis_index(PP_AXIS)
+
+
+def is_pipeline_first_stage(stage: int) -> bool:
+    """Host-side check for a host-driven pipeline stage index.
+
+    ≡ parallel_state.is_pipeline_first_stage (parallel_state.py:590) for the
+    non-virtual case; virtual chunks are handled by the schedule driver.
+    """
+    return stage == 0
+
+
+def is_pipeline_last_stage(stage: int) -> bool:
+    return stage == _state().pipeline_model_parallel_size - 1
+
+
+def get_rank_info() -> str:
+    """(dp, tp, pp) info string for log prefixes ≡ parallel_state.get_rank_info
+    (parallel_state.py:421-430).  Host-level: reports process index and mesh
+    shape (per-device coordinates are a compile-time notion under SPMD)."""
+    if _GLOBAL_STATE is None:
+        return f"proc{jax.process_index()}"
+    s = _GLOBAL_STATE
+    return (
+        f"proc{jax.process_index()} dp{s.data_parallel_size}"
+        f"/tp{s.tensor_model_parallel_size}/pp{s.pipeline_model_parallel_size}"
+    )
+
+
+# --- sharding constructors -------------------------------------------------
+
+def named_sharding(*spec) -> NamedSharding:
+    """NamedSharding over the global mesh from PartitionSpec entries."""
+    return NamedSharding(get_mesh(), P(*spec))
+
+
+def data_parallel_sharding(ndim: int) -> NamedSharding:
+    """Batch-dim sharding over dp (and pp folded in when pp==1 is absent)."""
+    spec = [DP_AXIS] + [None] * (ndim - 1)
+    return named_sharding(*spec)

@@ -1,0 +1,19 @@
+"""apex_tpu.transformer — Megatron-style TP/SP/PP parallelism library.
+
+≡ apex.transformer (apex/transformer/__init__.py): parallel_state (here:
+apex_tpu.parallel.mesh), tensor_parallel, pipeline_parallel, amp grad
+scaler, fused softmax, batch samplers, and testing models.
+"""
+
+from apex_tpu.parallel import mesh as parallel_state  # noqa: F401
+
+
+def __getattr__(name):
+    import importlib
+    submods = (
+        "tensor_parallel", "pipeline_parallel", "functional", "layers",
+        "testing", "microbatches",
+    )
+    if name in submods:
+        return importlib.import_module(f"apex_tpu.transformer.{name}")
+    raise AttributeError(name)

@@ -1,0 +1,36 @@
+"""Test harness: emulated 8-device CPU mesh.
+
+The reference tests distributed code by spawning ≤4 NCCL processes per
+node (apex/transformer/testing/distributed_test_base.py:22-74).  The
+TPU-native equivalent runs every test in ONE process against an 8-way
+virtual CPU mesh via XLA's host-platform device-count flag — collectives
+and shardings compile and execute exactly as on an 8-chip slice.
+"""
+
+import os
+
+# Force CPU: the session environment pins JAX_PLATFORMS to the real TPU
+# tunnel (axon) and pre-imports jax via sitecustomize, so env vars alone
+# are too late — use jax.config before any backend initializes.
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+jax.config.update("jax_enable_x64", False)
+assert jax.device_count() == 8, jax.devices()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _fresh_mesh_state():
+    yield
+    from apex_tpu.parallel import mesh
+    mesh.destroy_model_parallel()

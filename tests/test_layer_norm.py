@@ -1,0 +1,108 @@
+"""Fused LayerNorm/RMSNorm parity tests.
+
+≡ tests/L0/run_fused_layer_norm/test_fused_layer_norm.py — fused kernel
+vs reference math over dtype × shape grids, fwd and bwd.  The Pallas
+path runs in interpret mode on CPU.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.ops.layer_norm import (
+    FusedLayerNorm,
+    FusedRMSNorm,
+    fused_layer_norm,
+    fused_rms_norm,
+    layer_norm_reference,
+    rms_norm_reference,
+)
+
+SHAPES = [(4, 16), (3, 5, 96), (17, 128)]
+
+
+def _tol(dtype):
+    return dict(rtol=2e-2, atol=2e-2) if dtype == jnp.bfloat16 else dict(
+        rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("shape", SHAPES)
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+@pytest.mark.parametrize("affine", [True, False])
+def test_layer_norm_forward(shape, dtype, affine):
+    k = jax.random.PRNGKey(0)
+    x = jax.random.normal(k, shape, dtype)
+    h = shape[-1]
+    w = jax.random.normal(jax.random.PRNGKey(1), (h,), dtype) if affine else None
+    b = jax.random.normal(jax.random.PRNGKey(2), (h,), dtype) if affine else None
+    got = fused_layer_norm(x, w, b, use_pallas_override=True)
+    want = layer_norm_reference(x, w, b)
+    np.testing.assert_allclose(np.asarray(got, np.float32),
+                               np.asarray(want, np.float32), **_tol(dtype))
+
+
+@pytest.mark.parametrize("shape", SHAPES)
+@pytest.mark.parametrize("affine", [True, False])
+def test_layer_norm_grads(shape, affine):
+    k = jax.random.PRNGKey(3)
+    x = jax.random.normal(k, shape, jnp.float32)
+    h = shape[-1]
+    w = jnp.ones((h,)) * 1.5 if affine else None
+    b = jnp.ones((h,)) * 0.5 if affine else None
+
+    def loss_fused(x, w, b):
+        y = fused_layer_norm(x, w, b, use_pallas_override=True)
+        return jnp.sum(jnp.sin(y))
+
+    def loss_ref(x, w, b):
+        return jnp.sum(jnp.sin(layer_norm_reference(x, w, b)))
+
+    if affine:
+        g1 = jax.grad(loss_fused, argnums=(0, 1, 2))(x, w, b)
+        g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(x, w, b)
+    else:
+        g1 = (jax.grad(loss_fused)(x, w, b),)
+        g2 = (jax.grad(loss_ref)(x, w, b),)
+    for a, e in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(e),
+                                   rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.parametrize("shape", SHAPES)
+def test_rms_norm(shape):
+    x = jax.random.normal(jax.random.PRNGKey(4), shape, jnp.float32)
+    w = jnp.full((shape[-1],), 1.2)
+    got = fused_rms_norm(x, w, use_pallas_override=True)
+    want = rms_norm_reference(x, w)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+    def loss(x, w):
+        return jnp.sum(jnp.cos(fused_rms_norm(x, w, use_pallas_override=True)))
+
+    def loss_ref(x, w):
+        return jnp.sum(jnp.cos(rms_norm_reference(x, w)))
+
+    g1 = jax.grad(loss, argnums=(0, 1))(x, w)
+    g2 = jax.grad(loss_ref, argnums=(0, 1))(x, w)
+    for a, e in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(e),
+                                   rtol=1e-4, atol=1e-4)
+
+
+def test_modules():
+    ln = FusedLayerNorm(64)
+    params = ln.init()
+    x = jax.random.normal(jax.random.PRNGKey(5), (8, 64))
+    y = ln.apply(params, x, use_pallas_override=True)
+    np.testing.assert_allclose(
+        np.asarray(y), np.asarray(layer_norm_reference(
+            x, params["weight"], params["bias"])), rtol=1e-5, atol=1e-5)
+
+    rn = FusedRMSNorm(64)
+    p2 = rn.init()
+    y2 = rn.apply(p2, x, use_pallas_override=True)
+    np.testing.assert_allclose(
+        np.asarray(y2), np.asarray(rms_norm_reference(x, p2["weight"])),
+        rtol=1e-5, atol=1e-5)
