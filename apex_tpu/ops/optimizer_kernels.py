@@ -1,0 +1,440 @@
+"""Fused optimizer kernels over flat 1-D parameter buffers — Pallas.
+
+≡ the reference's `amp_C` extension (csrc/amp_C_frontend.cpp:175-204):
+multi_tensor_{adam,sgd,adagrad,novograd,lamb,l2norm,scale,axpby} built on
+the chunked multi_tensor_apply launcher (csrc/multi_tensor_apply.cuh:19-100).
+The TPU re-design replaces "hundreds of tensors, chunked kernel launches"
+with ONE flat fp32 buffer per state (see optimizers/flat.py for the
+pytree<->buffer mapping ≡ apex_C.flatten/unflatten): a single Pallas
+pass reads grad and state, applies decay/moments/bias-correction/update,
+and writes params+state in place (input_output_aliases ≡ in-place CUDA
+functors).  Grad unscaling and the overflow-skip are fused into the same
+pass (≡ the capturable CUDA-graph Adam, apex/optimizers/fused_adam.py:199-263:
+`inv_scale` multiply + `found_inf` masked update, no host sync).
+
+Per-tensor reductions (LAMB trust ratios, NovoGrad per-tensor norms) are
+computed as XLA segmented reductions over the flat buffer and passed in
+as per-element vectors — the analogue of the reference's two-phase
+l2norm→lamb launch pair (apex/optimizers/fused_lamb.py:124-199).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from apex_tpu.ops._common import pallas_interpret, use_pallas
+
+_LANES = 128
+_BLOCK_ROWS = 512  # (512, 128) fp32 tile = 256 KiB per operand
+
+
+def _to2d(flat):
+    n = flat.shape[0]
+    pad = (-n) % (_BLOCK_ROWS * _LANES)
+    if pad:
+        flat = jnp.pad(flat, (0, pad))
+    return flat.reshape(-1, _LANES), n
+
+
+def _from2d(x2, n):
+    return x2.reshape(-1)[:n]
+
+
+def _elementwise_call(kernel, arrays, n_out, interpret_override=None):
+    """Run an elementwise kernel over equally-shaped flat buffers.
+
+    The first `n_out` arrays are updated in place (aliased), mirroring
+    multi_tensor_apply's in-place tensor-list updates.
+    """
+    two_d = [_to2d(a)[0] for a in arrays]
+    n = arrays[0].shape[0]
+    rows = two_d[0].shape[0]
+    grid = rows // _BLOCK_ROWS
+    spec = pl.BlockSpec((_BLOCK_ROWS, _LANES), lambda i: (i, 0))
+    interp = pallas_interpret() if interpret_override is None else interpret_override
+    outs = pl.pallas_call(
+        kernel,
+        grid=(grid,),
+        in_specs=[spec] * len(two_d),
+        out_specs=[spec] * n_out,
+        out_shape=[jax.ShapeDtypeStruct(two_d[0].shape, two_d[i].dtype)
+                   for i in range(n_out)],
+        input_output_aliases={i: i for i in range(n_out)},
+        interpret=interp,
+    )(*two_d)
+    if n_out == 1:
+        outs = [outs] if not isinstance(outs, (list, tuple)) else outs
+    return [_from2d(o, n) for o in outs]
+
+
+# ------------------------------- Adam ---------------------------------------
+
+def _adam_kernel(p_ref, m_ref, v_ref, g_ref, sc_ref,
+                 p_out, m_out, v_out, *,
+                 beta1, beta2, eps, weight_decay, adam_w_mode,
+                 bias_correction):
+    """sc_ref rows: [lr, inv_scale, found_inf, bc1, bc2] broadcast scalars."""
+    g = g_ref[...].astype(jnp.float32)
+    p = p_ref[...].astype(jnp.float32)
+    m = m_ref[...]
+    v = v_ref[...]
+    lr = sc_ref[0, 0]
+    inv_scale = sc_ref[1, 0]
+    found_inf = sc_ref[2, 0]
+    bc1 = sc_ref[3, 0]
+    bc2 = sc_ref[4, 0]
+    g = g * inv_scale
+    if not adam_w_mode and weight_decay != 0.0:
+        g = g + weight_decay * p  # L2 mode ≡ ADAM_MODE_1 (multi_tensor_adam.cu)
+    m_new = beta1 * m + (1.0 - beta1) * g
+    v_new = beta2 * v + (1.0 - beta2) * g * g
+    if bias_correction:
+        mhat = m_new / bc1
+        vhat = v_new / bc2
+    else:
+        mhat, vhat = m_new, v_new
+    update = mhat / (jnp.sqrt(vhat) + eps)
+    if adam_w_mode and weight_decay != 0.0:
+        update = update + weight_decay * p  # AdamW ≡ ADAM_MODE_0
+    p_new = p - lr * update
+    keep = found_inf > 0.5
+    p_out[...] = jnp.where(keep, p, p_new).astype(p_out.dtype)
+    m_out[...] = jnp.where(keep, m, m_new)
+    v_out[...] = jnp.where(keep, v, v_new)
+
+
+def adam_flat(p, m, v, g, lr, step, *, beta1=0.9, beta2=0.999, eps=1e-8,
+              weight_decay=0.0, adam_w_mode=True, bias_correction=True,
+              inv_scale=1.0, found_inf=False, use_pallas_override=None):
+    """One fused Adam/AdamW step on flat buffers.
+
+    ≡ amp_C.multi_tensor_adam / multi_tensor_adam_capturable
+    (csrc/multi_tensor_adam.cu).  `step` may be traced (on-device step
+    count, ≡ capturable mode's GPU-side `step` tensor).
+    Returns (p, m, v) new buffers (donate inputs under jit).
+    """
+    step = jnp.asarray(step, jnp.float32)
+    bc1 = 1.0 - jnp.power(jnp.float32(beta1), step)
+    bc2 = 1.0 - jnp.power(jnp.float32(beta2), step)
+    scalars = jnp.stack([
+        jnp.asarray(lr, jnp.float32),
+        jnp.asarray(inv_scale, jnp.float32),
+        jnp.asarray(found_inf, jnp.float32),
+        bc1, bc2,
+    ]).reshape(5, 1)
+    if not use_pallas(use_pallas_override):
+        return _adam_reference(p, m, v, g, scalars, beta1, beta2, eps,
+                               weight_decay, adam_w_mode, bias_correction)
+    kernel = functools.partial(
+        _adam_kernel, beta1=beta1, beta2=beta2, eps=eps,
+        weight_decay=weight_decay, adam_w_mode=adam_w_mode,
+        bias_correction=bias_correction)
+    p2, np_ = _to2d(p)
+    m2, _ = _to2d(m)
+    v2, _ = _to2d(v)
+    g2, _ = _to2d(g)
+    rows = p2.shape[0]
+    grid = rows // _BLOCK_ROWS
+    spec = pl.BlockSpec((_BLOCK_ROWS, _LANES), lambda i: (i, 0))
+    sspec = pl.BlockSpec((5, 1), lambda i: (0, 0))
+    pn, mn, vn = pl.pallas_call(
+        kernel,
+        grid=(grid,),
+        in_specs=[spec, spec, spec, spec, sspec],
+        out_specs=[spec, spec, spec],
+        out_shape=[jax.ShapeDtypeStruct(p2.shape, p2.dtype),
+                   jax.ShapeDtypeStruct(m2.shape, jnp.float32),
+                   jax.ShapeDtypeStruct(v2.shape, jnp.float32)],
+        input_output_aliases={0: 0, 1: 1, 2: 2},
+        interpret=pallas_interpret(),
+    )(p2, m2, v2, g2, scalars)
+    return _from2d(pn, np_), _from2d(mn, np_), _from2d(vn, np_)
+
+
+def _adam_reference(p, m, v, g, scalars, beta1, beta2, eps, weight_decay,
+                    adam_w_mode, bias_correction):
+    lr, inv_scale, found_inf, bc1, bc2 = [scalars[i, 0] for i in range(5)]
+    g = g.astype(jnp.float32) * inv_scale
+    p32 = p.astype(jnp.float32)
+    if not adam_w_mode and weight_decay:
+        g = g + weight_decay * p32
+    m_new = beta1 * m + (1 - beta1) * g
+    v_new = beta2 * v + (1 - beta2) * g * g
+    mhat = m_new / bc1 if bias_correction else m_new
+    vhat = v_new / bc2 if bias_correction else v_new
+    update = mhat / (jnp.sqrt(vhat) + eps)
+    if adam_w_mode and weight_decay:
+        update = update + weight_decay * p32
+    p_new = p32 - lr * update
+    keep = found_inf > 0.5
+    return (jnp.where(keep, p32, p_new).astype(p.dtype),
+            jnp.where(keep, m, m_new), jnp.where(keep, v, v_new))
+
+
+# ------------------------------- SGD ----------------------------------------
+
+def _sgd_kernel(p_ref, b_ref, g_ref, sc_ref, p_out, b_out, *,
+                momentum, dampening, nesterov, weight_decay,
+                wd_after_momentum, first_run):
+    g = g_ref[...].astype(jnp.float32)
+    p = p_ref[...].astype(jnp.float32)
+    b = b_ref[...]
+    lr = sc_ref[0, 0]
+    inv_scale = sc_ref[1, 0]
+    found_inf = sc_ref[2, 0]
+    g = g * inv_scale
+    if weight_decay != 0.0 and not wd_after_momentum:
+        g = g + weight_decay * p
+    if momentum != 0.0:
+        if first_run:
+            b_new = g
+        else:
+            b_new = momentum * b + (1.0 - dampening) * g
+        upd = g + momentum * b_new if nesterov else b_new
+    else:
+        b_new = b
+        upd = g
+    if weight_decay != 0.0 and wd_after_momentum:
+        upd = upd + weight_decay * p
+    p_new = p - lr * upd
+    keep = found_inf > 0.5
+    p_out[...] = jnp.where(keep, p, p_new).astype(p_out.dtype)
+    b_out[...] = jnp.where(keep, b, b_new)
+
+
+def sgd_flat(p, buf, g, lr, *, momentum=0.0, dampening=0.0, nesterov=False,
+             weight_decay=0.0, wd_after_momentum=False, first_run=False,
+             inv_scale=1.0, found_inf=False, use_pallas_override=None):
+    """≡ amp_C.multi_tensor_sgd (csrc/multi_tensor_sgd_kernel.cu).
+    Returns (p, momentum_buffer)."""
+    scalars = jnp.stack([
+        jnp.asarray(lr, jnp.float32),
+        jnp.asarray(inv_scale, jnp.float32),
+        jnp.asarray(found_inf, jnp.float32),
+    ]).reshape(3, 1)
+    if not use_pallas(use_pallas_override):
+        # jnp fallback mirrors the kernel exactly
+        g32 = g.astype(jnp.float32) * scalars[1, 0]
+        p32 = p.astype(jnp.float32)
+        if weight_decay and not wd_after_momentum:
+            g32 = g32 + weight_decay * p32
+        if momentum != 0.0:
+            b_new = g32 if first_run else momentum * buf + (1 - dampening) * g32
+            upd = g32 + momentum * b_new if nesterov else b_new
+        else:
+            b_new, upd = buf, g32
+        if weight_decay and wd_after_momentum:
+            upd = upd + weight_decay * p32
+        p_new = p32 - scalars[0, 0] * upd
+        keep = scalars[2, 0] > 0.5
+        return (jnp.where(keep, p32, p_new).astype(p.dtype),
+                jnp.where(keep, buf, b_new))
+    kernel = functools.partial(
+        _sgd_kernel, momentum=momentum, dampening=dampening,
+        nesterov=nesterov, weight_decay=weight_decay,
+        wd_after_momentum=wd_after_momentum, first_run=first_run)
+    p2, n = _to2d(p)
+    b2, _ = _to2d(buf)
+    g2, _ = _to2d(g)
+    grid = p2.shape[0] // _BLOCK_ROWS
+    spec = pl.BlockSpec((_BLOCK_ROWS, _LANES), lambda i: (i, 0))
+    sspec = pl.BlockSpec((3, 1), lambda i: (0, 0))
+    pn, bn = pl.pallas_call(
+        kernel,
+        grid=(grid,),
+        in_specs=[spec, spec, spec, sspec],
+        out_specs=[spec, spec],
+        out_shape=[jax.ShapeDtypeStruct(p2.shape, p2.dtype),
+                   jax.ShapeDtypeStruct(b2.shape, jnp.float32)],
+        input_output_aliases={0: 0, 1: 1},
+        interpret=pallas_interpret(),
+    )(p2, b2, g2, scalars)
+    return _from2d(pn, n), _from2d(bn, n)
+
+
+# ----------------------------- Adagrad --------------------------------------
+
+def _adagrad_kernel(p_ref, h_ref, g_ref, sc_ref, p_out, h_out, *,
+                    eps, weight_decay, adagrad_w_mode):
+    g = g_ref[...].astype(jnp.float32)
+    p = p_ref[...].astype(jnp.float32)
+    h = h_ref[...]
+    lr = sc_ref[0, 0]
+    if not adagrad_w_mode and weight_decay != 0.0:
+        g = g + weight_decay * p
+    h_new = h + g * g
+    upd = g / (jnp.sqrt(h_new) + eps)
+    if adagrad_w_mode and weight_decay != 0.0:
+        upd = upd + weight_decay * p
+    p_out[...] = (p - lr * upd).astype(p_out.dtype)
+    h_out[...] = h_new
+
+
+def adagrad_flat(p, h, g, lr, *, eps=1e-10, weight_decay=0.0,
+                 adagrad_w_mode=False, use_pallas_override=None):
+    """≡ amp_C.multi_tensor_adagrad (csrc/multi_tensor_adagrad.cu)."""
+    scalars = jnp.asarray(lr, jnp.float32).reshape(1, 1)
+    if not use_pallas(use_pallas_override):
+        g32 = g.astype(jnp.float32)
+        p32 = p.astype(jnp.float32)
+        if not adagrad_w_mode and weight_decay:
+            g32 = g32 + weight_decay * p32
+        h_new = h + g32 * g32
+        upd = g32 / (jnp.sqrt(h_new) + eps)
+        if adagrad_w_mode and weight_decay:
+            upd = upd + weight_decay * p32
+        return (p32 - scalars[0, 0] * upd).astype(p.dtype), h_new
+    kernel = functools.partial(_adagrad_kernel, eps=eps,
+                               weight_decay=weight_decay,
+                               adagrad_w_mode=adagrad_w_mode)
+    p2, n = _to2d(p)
+    h2, _ = _to2d(h)
+    g2, _ = _to2d(g)
+    grid = p2.shape[0] // _BLOCK_ROWS
+    spec = pl.BlockSpec((_BLOCK_ROWS, _LANES), lambda i: (i, 0))
+    sspec = pl.BlockSpec((1, 1), lambda i: (0, 0))
+    pn, hn = pl.pallas_call(
+        kernel,
+        grid=(grid,),
+        in_specs=[spec, spec, spec, sspec],
+        out_specs=[spec, spec],
+        out_shape=[jax.ShapeDtypeStruct(p2.shape, p2.dtype),
+                   jax.ShapeDtypeStruct(h2.shape, jnp.float32)],
+        input_output_aliases={0: 0, 1: 1},
+        interpret=pallas_interpret(),
+    )(p2, h2, g2, scalars)
+    return _from2d(pn, n), _from2d(hn, n)
+
+
+# ------------------------- LAMB (two-phase) ---------------------------------
+
+def _lamb_phase1_kernel(m_ref, v_ref, g_ref, p_ref, sc_ref,
+                        m_out, v_out, u_out, *,
+                        beta1, beta2, eps, weight_decay, bias_correction):
+    """Phase 1 ≡ amp_C.multi_tensor_lamb_stage1 / lamb stage computing the
+    raw update u = mhat/(sqrt(vhat)+eps) + wd*p with global-grad-norm
+    clipping fused (sc rows: [clip_ratio, bc1, bc2])."""
+    g = g_ref[...].astype(jnp.float32) * sc_ref[0, 0]
+    p = p_ref[...].astype(jnp.float32)
+    m_new = beta1 * m_ref[...] + (1.0 - beta1) * g
+    v_new = beta2 * v_ref[...] + (1.0 - beta2) * g * g
+    mhat = m_new / sc_ref[1, 0] if bias_correction else m_new
+    vhat = v_new / sc_ref[2, 0] if bias_correction else v_new
+    u = mhat / (jnp.sqrt(vhat) + eps)
+    if weight_decay != 0.0:
+        u = u + weight_decay * p
+    m_out[...] = m_new
+    v_out[...] = v_new
+    u_out[...] = u
+
+
+def _lamb_phase2_kernel(p_ref, u_ref, r_ref, sc_ref, p_out):
+    """Phase 2 ≡ multi_tensor_lamb_stage2: p -= lr * trust_ratio * u, with
+    the per-element trust-ratio vector r."""
+    lr = sc_ref[0, 0]
+    p = p_ref[...].astype(jnp.float32)
+    p_out[...] = (p - lr * r_ref[...] * u_ref[...]).astype(p_out.dtype)
+
+
+def lamb_phase1_flat(m, v, g, p, clip_ratio, step, *, beta1, beta2, eps,
+                     weight_decay, bias_correction=True,
+                     use_pallas_override=None):
+    step = jnp.asarray(step, jnp.float32)
+    bc1 = 1.0 - jnp.power(jnp.float32(beta1), step)
+    bc2 = 1.0 - jnp.power(jnp.float32(beta2), step)
+    scalars = jnp.stack([jnp.asarray(clip_ratio, jnp.float32), bc1,
+                         bc2]).reshape(3, 1)
+    if not use_pallas(use_pallas_override):
+        g32 = g.astype(jnp.float32) * scalars[0, 0]
+        p32 = p.astype(jnp.float32)
+        m_new = beta1 * m + (1 - beta1) * g32
+        v_new = beta2 * v + (1 - beta2) * g32 * g32
+        mhat = m_new / bc1 if bias_correction else m_new
+        vhat = v_new / bc2 if bias_correction else v_new
+        u = mhat / (jnp.sqrt(vhat) + eps)
+        if weight_decay:
+            u = u + weight_decay * p32
+        return m_new, v_new, u
+    kernel = functools.partial(
+        _lamb_phase1_kernel, beta1=beta1, beta2=beta2, eps=eps,
+        weight_decay=weight_decay, bias_correction=bias_correction)
+    m2, n = _to2d(m)
+    v2, _ = _to2d(v)
+    g2, _ = _to2d(g)
+    p2, _ = _to2d(p)
+    grid = m2.shape[0] // _BLOCK_ROWS
+    spec = pl.BlockSpec((_BLOCK_ROWS, _LANES), lambda i: (i, 0))
+    sspec = pl.BlockSpec((3, 1), lambda i: (0, 0))
+    mn, vn, u = pl.pallas_call(
+        kernel,
+        grid=(grid,),
+        in_specs=[spec, spec, spec, spec, sspec],
+        out_specs=[spec, spec, spec],
+        out_shape=[jax.ShapeDtypeStruct(m2.shape, jnp.float32)] * 3,
+        input_output_aliases={0: 0, 1: 1},
+        interpret=pallas_interpret(),
+    )(m2, v2, g2, p2, scalars)
+    return _from2d(mn, n), _from2d(vn, n), _from2d(u, n)
+
+
+def lamb_phase2_flat(p, u, ratio_elem, lr, use_pallas_override=None):
+    scalars = jnp.asarray(lr, jnp.float32).reshape(1, 1)
+    if not use_pallas(use_pallas_override):
+        return (p.astype(jnp.float32) - scalars[0, 0] * ratio_elem * u
+                ).astype(p.dtype)
+    p2, n = _to2d(p)
+    u2, _ = _to2d(u)
+    r2, _ = _to2d(ratio_elem)
+    grid = p2.shape[0] // _BLOCK_ROWS
+    spec = pl.BlockSpec((_BLOCK_ROWS, _LANES), lambda i: (i, 0))
+    sspec = pl.BlockSpec((1, 1), lambda i: (0, 0))
+    pn = pl.pallas_call(
+        _lamb_phase2_kernel,
+        grid=(grid,),
+        in_specs=[spec, spec, spec, sspec],
+        out_specs=spec,
+        out_shape=jax.ShapeDtypeStruct(p2.shape, p2.dtype),
+        input_output_aliases={0: 0},
+        interpret=pallas_interpret(),
+    )(p2, u2, r2, scalars)
+    return _from2d(pn, n)
+
+
+# --------------------------- reductions / utilities -------------------------
+
+def l2norm_flat(flat):
+    """Global L2 norm ≡ amp_C.multi_tensor_l2norm (csrc/multi_tensor_l2norm_kernel.cu).
+    XLA lowers this to an optimal tree reduction; no Pallas needed."""
+    return jnp.sqrt(jnp.sum(jnp.square(flat.astype(jnp.float32))))
+
+
+def per_tensor_l2norm(flat, sizes):
+    """Per-tensor norms over a flat buffer ≡ multi_tensor_l2norm
+    per_tensor=True mode.  `sizes` is the static segment-length list."""
+    norms = []
+    off = 0
+    for s in sizes:
+        seg = jax.lax.dynamic_slice(flat, (off,), (s,))
+        norms.append(jnp.sqrt(jnp.sum(jnp.square(seg.astype(jnp.float32)))))
+        off += s
+    return jnp.stack(norms)
+
+
+def expand_per_tensor(values, sizes, total):
+    """Broadcast per-tensor scalars to per-element vector (static sizes)."""
+    return jnp.repeat(values, jnp.asarray(sizes), total_repeat_length=total)
+
+
+def scale_flat(flat, scale):
+    """≡ amp_C.multi_tensor_scale: scaled copy; overflow check is fused by
+    XLA into the same pass when consumed with jnp.isfinite."""
+    return flat.astype(jnp.float32) * scale
+
+
+def axpby_flat(a, x, b, y):
+    """≡ amp_C.multi_tensor_axpby: a*x + b*y."""
+    return a * x.astype(jnp.float32) + b * y.astype(jnp.float32)

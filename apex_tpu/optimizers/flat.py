@@ -1,0 +1,60 @@
+"""Pytree <-> flat 1-D buffer mapping.
+
+≡ the reference's `apex_C` extension (csrc/flatten_unflatten.cpp:16-17,
+torch's flatten_dense_tensors) plus the dtype-partitioned list building
+every fused optimizer does per step (apex/optimizers/fused_adam.py:163-197).
+In JAX the flattening happens once at optimizer init; the training step
+then moves a single fused buffer through the Pallas optimizer kernels —
+no per-step re-bucketing, no 110-tensor launch limits.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class FlatSpec:
+    """Static description of the pytree layout inside the flat buffer."""
+
+    treedef: Any
+    shapes: Tuple[Tuple[int, ...], ...]
+    dtypes: Tuple[Any, ...]
+    sizes: Tuple[int, ...]
+    offsets: Tuple[int, ...]
+    total: int
+
+
+def make_spec(tree) -> FlatSpec:
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    shapes = tuple(tuple(l.shape) for l in leaves)
+    dtypes = tuple(l.dtype for l in leaves)
+    sizes = tuple(int(np.prod(s)) if s else 1 for s in shapes)
+    offsets = tuple(int(o) for o in np.cumsum((0,) + sizes[:-1]))
+    return FlatSpec(treedef=treedef, shapes=shapes, dtypes=dtypes,
+                    sizes=sizes, offsets=offsets, total=int(sum(sizes)))
+
+
+def flatten(tree, dtype=jnp.float32):
+    """Concatenate all leaves into one 1-D buffer (cast to `dtype`)."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    if not leaves:
+        return jnp.zeros((0,), dtype)
+    return jnp.concatenate([l.astype(dtype).reshape(-1) for l in leaves])
+
+
+def unflatten(flat, spec: FlatSpec, cast_to_leaf_dtype: bool = True):
+    """Rebuild the pytree from a flat buffer (XLA: pure slicing, fused)."""
+    leaves = []
+    for shape, dt, size, off in zip(spec.shapes, spec.dtypes, spec.sizes,
+                                    spec.offsets):
+        leaf = jax.lax.dynamic_slice(flat, (off,), (size,)).reshape(shape)
+        if cast_to_leaf_dtype:
+            leaf = leaf.astype(dt)
+        leaves.append(leaf)
+    return jax.tree_util.tree_unflatten(spec.treedef, leaves)

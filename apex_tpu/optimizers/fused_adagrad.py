@@ -1,0 +1,48 @@
+"""FusedAdagrad ≡ apex.optimizers.FusedAdagrad
+(apex/optimizers/fused_adagrad.py): one flat Pallas pass
+(amp_C.multi_tensor_adagrad) with optional decoupled ("adagrad_w_mode")
+weight decay.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax.numpy as jnp
+
+from apex_tpu.ops import optimizer_kernels as K
+from apex_tpu.optimizers import flat as F
+
+
+class FusedAdagradState(NamedTuple):
+    step: jnp.ndarray
+    params: jnp.ndarray
+    sum_sq: jnp.ndarray
+
+
+class FusedAdagrad:
+    def __init__(self, lr=1e-2, eps=1e-10, weight_decay=0.0,
+                 adagrad_w_mode=False, use_pallas: Optional[bool] = None):
+        self.lr = lr
+        self.eps = eps
+        self.weight_decay = weight_decay
+        self.adagrad_w_mode = adagrad_w_mode
+        self.use_pallas = use_pallas
+        self.spec = None
+
+    def init(self, params) -> FusedAdagradState:
+        self.spec = F.make_spec(params)
+        flat = F.flatten(params, jnp.float32)
+        return FusedAdagradState(step=jnp.zeros((), jnp.int32), params=flat,
+                                 sum_sq=jnp.zeros_like(flat))
+
+    def step(self, state: FusedAdagradState, grads, lr=None):
+        g_flat = F.flatten(grads, jnp.float32)
+        p, h = K.adagrad_flat(
+            state.params, state.sum_sq, g_flat,
+            lr=self.lr if lr is None else lr, eps=self.eps,
+            weight_decay=self.weight_decay,
+            adagrad_w_mode=self.adagrad_w_mode,
+            use_pallas_override=self.use_pallas)
+        new_state = FusedAdagradState(step=state.step + 1, params=p, sum_sq=h)
+        return F.unflatten(p, self.spec), new_state

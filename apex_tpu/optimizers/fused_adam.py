@@ -1,0 +1,90 @@
+"""FusedAdam — single-kernel Adam/AdamW over a flat buffer.
+
+≡ apex.optimizers.FusedAdam (apex/optimizers/fused_adam.py:4,127-305):
+the reference partitions params by dtype and issues one
+multi_tensor_adam launch per group; here all params live in one flat
+fp32 buffer and one Pallas pass applies the whole update.  The
+"capturable" CUDA-graph variant (fused_adam.py:199-263) is the *default*
+semantics in JAX: lr/step/inv_scale/found_inf are on-device scalars and
+the overflow-skip is a masked update inside the kernel — no host sync.
+
+Master weights: when `master_weights=True` (≡ FusedMixedPrecisionLamb /
+amp O2 master params), the fp32 flat buffer IS the master copy and
+`step()` returns params cast back to their storage dtype.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.ops import optimizer_kernels as K
+from apex_tpu.optimizers import flat as F
+
+
+class FusedAdamState(NamedTuple):
+    step: jnp.ndarray      # i32 scalar
+    params: jnp.ndarray    # flat fp32 (master) param buffer
+    exp_avg: jnp.ndarray   # flat fp32 m
+    exp_avg_sq: jnp.ndarray  # flat fp32 v
+
+
+class FusedAdam:
+    """API shape: opt = FusedAdam(lr=...); state = opt.init(params);
+    params, state = opt.step(state, grads[, lr=, inv_scale=, found_inf=]).
+    """
+
+    def __init__(self, lr=1e-3, bias_correction=True, betas=(0.9, 0.999),
+                 eps=1e-8, adam_w_mode=True, weight_decay=0.0,
+                 amsgrad=False, use_pallas: Optional[bool] = None):
+        if amsgrad:
+            # ≡ reference raise (apex/optimizers/fused_adam.py:121-122)
+            raise RuntimeError("FusedAdam does not support the AMSGrad variant.")
+        self.lr = lr
+        self.bias_correction = bias_correction
+        self.beta1, self.beta2 = betas
+        self.eps = eps
+        self.adam_w_mode = adam_w_mode
+        self.weight_decay = weight_decay
+        self.use_pallas = use_pallas
+        self.spec: Optional[F.FlatSpec] = None
+
+    def init(self, params) -> FusedAdamState:
+        self.spec = F.make_spec(params)
+        flat = F.flatten(params, jnp.float32)
+        zeros = jnp.zeros_like(flat)
+        return FusedAdamState(step=jnp.zeros((), jnp.int32), params=flat,
+                              exp_avg=zeros, exp_avg_sq=zeros)
+
+    def step(self, state: FusedAdamState, grads, lr=None, inv_scale=1.0,
+             found_inf=False):
+        """One fused step.  Returns (params_pytree, new_state)."""
+        if self.spec is None:
+            raise RuntimeError("call init(params) before step()")
+        g_flat = F.flatten(grads, jnp.float32)
+        found = jnp.asarray(found_inf)
+        step_next = state.step + jnp.where(found, 0, 1).astype(jnp.int32)
+        p, m, v = K.adam_flat(
+            state.params, state.exp_avg, state.exp_avg_sq, g_flat,
+            lr=self.lr if lr is None else lr,
+            step=step_next.astype(jnp.float32),
+            beta1=self.beta1, beta2=self.beta2, eps=self.eps,
+            weight_decay=self.weight_decay, adam_w_mode=self.adam_w_mode,
+            bias_correction=self.bias_correction, inv_scale=inv_scale,
+            found_inf=found, use_pallas_override=self.use_pallas)
+        new_state = FusedAdamState(step=step_next, params=p, exp_avg=m,
+                                   exp_avg_sq=v)
+        return F.unflatten(p, self.spec), new_state
+
+    # --- checkpoint parity ≡ torch optimizer state_dict -------------------
+    def state_dict(self, state: FusedAdamState) -> dict:
+        return {"step": state.step, "params": state.params,
+                "exp_avg": state.exp_avg, "exp_avg_sq": state.exp_avg_sq}
+
+    def load_state_dict(self, d: dict) -> FusedAdamState:
+        return FusedAdamState(step=jnp.asarray(d["step"], jnp.int32),
+                              params=jnp.asarray(d["params"]),
+                              exp_avg=jnp.asarray(d["exp_avg"]),
+                              exp_avg_sq=jnp.asarray(d["exp_avg_sq"]))

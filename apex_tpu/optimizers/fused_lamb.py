@@ -1,0 +1,106 @@
+"""FusedLAMB ≡ apex.optimizers.FusedLAMB (apex/optimizers/fused_lamb.py):
+two-phase LAMB — (1) global-grad-norm computation + clipping and the
+Adam-style raw update, (2) per-tensor trust-ratio application — matching
+the reference's multi_tensor_l2norm → multi_tensor_lamb launch pair
+(fused_lamb.py:124-133, 183-199).  Per-tensor norms are XLA segmented
+reductions over the flat buffer; phases are Pallas kernels.
+
+FusedMixedPrecisionLamb (apex/optimizers/fused_mixed_precision_lamb.py)
+is the same algorithm with fp32 master state over low-precision model
+params — subsumed here since the flat buffer is always the fp32 master.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax.numpy as jnp
+
+from apex_tpu.ops import optimizer_kernels as K
+from apex_tpu.optimizers import flat as F
+
+
+class FusedLAMBState(NamedTuple):
+    step: jnp.ndarray
+    params: jnp.ndarray
+    exp_avg: jnp.ndarray
+    exp_avg_sq: jnp.ndarray
+
+
+class FusedLAMB:
+    def __init__(self, lr=1e-3, bias_correction=True, betas=(0.9, 0.999),
+                 eps=1e-6, weight_decay=0.01, amsgrad=False,
+                 adam_w_mode=True, grad_averaging=True,
+                 max_grad_norm=1.0, use_nvlamb=False,
+                 use_pallas: Optional[bool] = None):
+        if amsgrad:
+            raise RuntimeError("FusedLAMB does not support the AMSGrad variant.")
+        self.lr = lr
+        self.bias_correction = bias_correction
+        self.beta1, self.beta2 = betas
+        self.eps = eps
+        self.weight_decay = weight_decay
+        self.adam_w_mode = adam_w_mode
+        self.grad_averaging = grad_averaging
+        self.max_grad_norm = max_grad_norm
+        self.use_nvlamb = use_nvlamb
+        self.use_pallas = use_pallas
+        self.spec = None
+
+    def init(self, params) -> FusedLAMBState:
+        self.spec = F.make_spec(params)
+        flat = F.flatten(params, jnp.float32)
+        zeros = jnp.zeros_like(flat)
+        return FusedLAMBState(step=jnp.zeros((), jnp.int32), params=flat,
+                              exp_avg=zeros, exp_avg_sq=zeros)
+
+    def step(self, state: FusedLAMBState, grads, lr=None, inv_scale=1.0,
+             found_inf=False):
+        g_flat = F.flatten(grads, jnp.float32) * jnp.asarray(
+            inv_scale, jnp.float32)
+        found = jnp.asarray(found_inf)
+        step_next = state.step + jnp.where(found, 0, 1).astype(jnp.int32)
+        lr_val = self.lr if lr is None else lr
+
+        # phase 0: global grad norm + clip ratio (fused_lamb.py:124-133,
+        # 169-181: clip when norm > max_grad_norm)
+        gnorm = K.l2norm_flat(g_flat)
+        if self.max_grad_norm and self.max_grad_norm > 0:
+            clip = jnp.where(gnorm > self.max_grad_norm,
+                             self.max_grad_norm / gnorm, 1.0)
+        else:
+            clip = jnp.float32(1.0)
+        beta1 = self.beta1
+        grad_scale = clip * (1.0 if self.grad_averaging else 1.0)
+
+        m, v, u = K.lamb_phase1_flat(
+            state.exp_avg, state.exp_avg_sq, g_flat, state.params,
+            clip_ratio=grad_scale, step=step_next.astype(jnp.float32),
+            beta1=beta1, beta2=self.beta2, eps=self.eps,
+            weight_decay=self.weight_decay,
+            bias_correction=self.bias_correction,
+            use_pallas_override=self.use_pallas)
+
+        # per-tensor trust ratios ≡ the lamb kernel's
+        # ratio = w_norm / u_norm when both > 0 else 1
+        sizes = self.spec.sizes
+        wn = K.per_tensor_l2norm(state.params, sizes)
+        un = K.per_tensor_l2norm(u, sizes)
+        ratio = jnp.where((wn > 0) & (un > 0), wn / jnp.maximum(un, 1e-12),
+                          1.0)
+        ratio_elem = K.expand_per_tensor(ratio, sizes, self.spec.total)
+
+        p_new = K.lamb_phase2_flat(state.params, u, ratio_elem, lr_val,
+                                   use_pallas_override=self.use_pallas)
+        # overflow skip: masked update
+        p = jnp.where(found, state.params, p_new)
+        m = jnp.where(found, state.exp_avg, m)
+        v = jnp.where(found, state.exp_avg_sq, v)
+        new_state = FusedLAMBState(step=step_next, params=p, exp_avg=m,
+                                   exp_avg_sq=v)
+        return F.unflatten(p, self.spec), new_state
+
+
+class FusedMixedPrecisionLamb(FusedLAMB):
+    """≡ apex.optimizers.FusedMixedPrecisionLamb — identical math; the
+    flat fp32 buffer already is the master copy of low-precision params."""

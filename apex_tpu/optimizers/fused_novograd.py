@@ -1,0 +1,98 @@
+"""FusedNovoGrad ≡ apex.optimizers.FusedNovoGrad
+(apex/optimizers/fused_novograd.py): layer-wise second moment — v is a
+per-tensor scalar EMA of the grad norm — with the elementwise moment/
+param update as a flat Pallas pass (amp_C.multi_tensor_novograd).
+The per-tensor norm reduction is an XLA segmented reduction.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax.numpy as jnp
+
+from apex_tpu.ops import optimizer_kernels as K
+from apex_tpu.optimizers import flat as F
+
+
+class FusedNovoGradState(NamedTuple):
+    step: jnp.ndarray
+    params: jnp.ndarray
+    exp_avg: jnp.ndarray        # flat m
+    exp_avg_sq: jnp.ndarray     # (num_tensors,) per-tensor v
+
+
+class FusedNovoGrad:
+    def __init__(self, lr=1e-3, bias_correction=True, betas=(0.95, 0.98),
+                 eps=1e-8, weight_decay=0.0, grad_averaging=False,
+                 amsgrad=False, reg_inside_moment=False,
+                 norm_type=2, init_zero=False,
+                 use_pallas: Optional[bool] = None):
+        if amsgrad:
+            raise RuntimeError("FusedNovoGrad does not support the AMSGrad variant.")
+        if norm_type != 2:
+            raise ValueError("FusedNovoGrad only supports l2 norm now")
+        self.lr = lr
+        self.bias_correction = bias_correction
+        self.beta1, self.beta2 = betas
+        self.eps = eps
+        self.weight_decay = weight_decay
+        self.grad_averaging = grad_averaging
+        self.reg_inside_moment = reg_inside_moment
+        self.init_zero = init_zero
+        self.use_pallas = use_pallas
+        self.spec = None
+
+    def init(self, params) -> FusedNovoGradState:
+        self.spec = F.make_spec(params)
+        flat = F.flatten(params, jnp.float32)
+        n_tensors = len(self.spec.sizes)
+        return FusedNovoGradState(
+            step=jnp.zeros((), jnp.int32), params=flat,
+            exp_avg=jnp.zeros_like(flat),
+            exp_avg_sq=jnp.zeros((n_tensors,), jnp.float32))
+
+    def step(self, state: FusedNovoGradState, grads, lr=None, inv_scale=1.0,
+             found_inf=False):
+        g_flat = F.flatten(grads, jnp.float32) * jnp.asarray(
+            inv_scale, jnp.float32)
+        found = jnp.asarray(found_inf)
+        step_next = state.step + jnp.where(found, 0, 1).astype(jnp.int32)
+        lr_val = self.lr if lr is None else lr
+        sizes = self.spec.sizes
+
+        # per-tensor ||g||^2 EMA (fused_novograd.py: v init at first step
+        # with the raw norm unless init_zero)
+        gn2 = jnp.square(K.per_tensor_l2norm(g_flat, sizes))
+        first = state.step == 0
+        if self.init_zero:
+            v_prev = state.exp_avg_sq
+            v_new = self.beta2 * v_prev + (1.0 - self.beta2) * gn2
+        else:
+            v_cont = self.beta2 * state.exp_avg_sq + (1.0 - self.beta2) * gn2
+            v_new = jnp.where(first, gn2, v_cont)
+
+        denom = jnp.sqrt(v_new) + self.eps
+        denom_elem = K.expand_per_tensor(denom, sizes, self.spec.total)
+
+        p32 = state.params
+        gg = g_flat / denom_elem
+        if self.weight_decay and self.reg_inside_moment:
+            gg = gg + self.weight_decay * p32
+        beta1_scale = (1.0 - self.beta1) if self.grad_averaging else 1.0
+        m_new = self.beta1 * state.exp_avg + beta1_scale * gg
+        upd = m_new
+        if self.weight_decay and not self.reg_inside_moment:
+            upd = upd + self.weight_decay * p32
+        if self.bias_correction:
+            bc1 = 1.0 - jnp.power(jnp.float32(self.beta1),
+                                  step_next.astype(jnp.float32))
+            upd = upd / bc1
+        p_new = p32 - lr_val * upd
+
+        p = jnp.where(found, state.params, p_new)
+        m = jnp.where(found, state.exp_avg, m_new)
+        v = jnp.where(found, state.exp_avg_sq, v_new)
+        new_state = FusedNovoGradState(step=step_next, params=p, exp_avg=m,
+                                       exp_avg_sq=v)
+        return F.unflatten(p, self.spec), new_state

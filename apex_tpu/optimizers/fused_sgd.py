@@ -1,0 +1,87 @@
+"""FusedSGD ≡ apex.optimizers.FusedSGD (apex/optimizers/fused_sgd.py):
+momentum/dampening/nesterov/weight-decay SGD as one flat Pallas pass
+(amp_C.multi_tensor_sgd), with the reference's `wd_after_momentum` and
+`materialize_master_grads` semantics subsumed by the fp32 flat buffer.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax.numpy as jnp
+
+from apex_tpu.ops import optimizer_kernels as K
+from apex_tpu.optimizers import flat as F
+
+
+class FusedSGDState(NamedTuple):
+    step: jnp.ndarray
+    params: jnp.ndarray
+    momentum_buffer: jnp.ndarray
+
+
+class FusedSGD:
+    def __init__(self, lr=1e-3, momentum=0.0, dampening=0.0,
+                 weight_decay=0.0, nesterov=False,
+                 wd_after_momentum=False,
+                 use_pallas: Optional[bool] = None):
+        if nesterov and (momentum <= 0 or dampening != 0):
+            raise ValueError(
+                "Nesterov momentum requires a momentum and zero dampening")
+        self.lr = lr
+        self.momentum = momentum
+        self.dampening = dampening
+        self.weight_decay = weight_decay
+        self.nesterov = nesterov
+        self.wd_after_momentum = wd_after_momentum
+        self.use_pallas = use_pallas
+        self.spec = None
+
+    def init(self, params) -> FusedSGDState:
+        self.spec = F.make_spec(params)
+        flat = F.flatten(params, jnp.float32)
+        return FusedSGDState(step=jnp.zeros((), jnp.int32), params=flat,
+                             momentum_buffer=jnp.zeros_like(flat))
+
+    def step(self, state: FusedSGDState, grads, lr=None, inv_scale=1.0,
+             found_inf=False):
+        g_flat = F.flatten(grads, jnp.float32)
+        found = jnp.asarray(found_inf)
+        # first_run initializes the momentum buffer with the raw grad
+        # (≡ torch SGD buf-is-None branch); branch-free via buffer math:
+        # step==0 → buf := g is equivalent to momentum*0 + (1-damp)*g only
+        # when dampening==0, so emulate with a traced select on step.
+        first = state.step == 0
+        if self.momentum != 0.0:
+            # compute both branches, select (cheap: one extra elementwise)
+            p1, b1 = K.sgd_flat(
+                state.params, state.momentum_buffer, g_flat,
+                lr=self.lr if lr is None else lr, momentum=self.momentum,
+                dampening=self.dampening, nesterov=self.nesterov,
+                weight_decay=self.weight_decay,
+                wd_after_momentum=self.wd_after_momentum, first_run=True,
+                inv_scale=inv_scale, found_inf=found,
+                use_pallas_override=self.use_pallas)
+            p2, b2 = K.sgd_flat(
+                state.params, state.momentum_buffer, g_flat,
+                lr=self.lr if lr is None else lr, momentum=self.momentum,
+                dampening=self.dampening, nesterov=self.nesterov,
+                weight_decay=self.weight_decay,
+                wd_after_momentum=self.wd_after_momentum, first_run=False,
+                inv_scale=inv_scale, found_inf=found,
+                use_pallas_override=self.use_pallas)
+            p = jnp.where(first, p1, p2)
+            buf = jnp.where(first, b1, b2)
+        else:
+            p, buf = K.sgd_flat(
+                state.params, state.momentum_buffer, g_flat,
+                lr=self.lr if lr is None else lr, momentum=0.0,
+                dampening=self.dampening, nesterov=False,
+                weight_decay=self.weight_decay,
+                wd_after_momentum=self.wd_after_momentum, first_run=False,
+                inv_scale=inv_scale, found_inf=found,
+                use_pallas_override=self.use_pallas)
+        step_next = state.step + jnp.where(found, 0, 1).astype(jnp.int32)
+        new_state = FusedSGDState(step=step_next, params=p,
+                                  momentum_buffer=buf)
+        return F.unflatten(p, self.spec), new_state
