@@ -30,21 +30,17 @@ __all__ = [
 
 
 def __getattr__(name):
-    # Lazy imports for heavier submodules.
-    if name in ("DistributedDataParallel", "ddp"):
-        from apex_tpu.parallel import ddp as _ddp
-        if name == "ddp":
-            return _ddp
-        return _ddp.DistributedDataParallel
-    if name in ("SyncBatchNorm", "sync_batchnorm"):
-        from apex_tpu.parallel import sync_batchnorm as _sbn
-        if name == "sync_batchnorm":
-            return _sbn
-        return _sbn.SyncBatchNorm
+    # Lazy imports for heavier submodules (importlib avoids re-entering
+    # this __getattr__ during the submodule's own import).
+    import importlib
+    if name in ("ddp", "sync_batchnorm", "larc", "clip_grad"):
+        return importlib.import_module(f"apex_tpu.parallel.{name}")
+    if name == "DistributedDataParallel":
+        return importlib.import_module(
+            "apex_tpu.parallel.ddp").DistributedDataParallel
+    if name == "SyncBatchNorm":
+        return importlib.import_module(
+            "apex_tpu.parallel.sync_batchnorm").SyncBatchNorm
     if name == "LARC":
-        from apex_tpu.parallel.larc import LARC
-        return LARC
-    if name == "clip_grad":
-        from apex_tpu.parallel import clip_grad
-        return clip_grad
+        return importlib.import_module("apex_tpu.parallel.larc").LARC
     raise AttributeError(name)

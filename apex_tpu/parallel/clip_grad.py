@@ -1,0 +1,40 @@
+"""Fused gradient clipping.
+
+≡ apex.contrib.clip_grad.clip_grad_norm_ (apex/contrib/clip_grad/clip_grad.py:16):
+multi-tensor L2-norm + scale.  On TPU the norm is one fused XLA
+reduction over the flat buffer and the scale fuses into whatever
+consumes the grads next.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.ops import optimizer_kernels as K
+from apex_tpu.optimizers import flat as F
+
+
+def clip_grad_norm(grads, max_norm: float, norm_type: float = 2.0):
+    """Returns (clipped_grads, total_norm).
+
+    Matches torch semantics (clip only when total_norm > max_norm);
+    inf-norm supported like the reference (clip_grad.py:49-57).
+    """
+    leaves = jax.tree_util.tree_leaves(grads)
+    if norm_type == 2.0:
+        total = K.l2norm_flat(F.flatten(grads, jnp.float32))
+    elif norm_type == float("inf"):
+        total = jnp.max(jnp.stack(
+            [jnp.max(jnp.abs(l.astype(jnp.float32))) for l in leaves]))
+    else:
+        total = jnp.power(sum(
+            jnp.sum(jnp.power(jnp.abs(l.astype(jnp.float32)), norm_type))
+            for l in leaves), 1.0 / norm_type)
+    scale = jnp.where(total > max_norm, max_norm / (total + 1e-6), 1.0)
+    clipped = jax.tree_util.tree_map(
+        lambda g: (g.astype(jnp.float32) * scale).astype(g.dtype), grads)
+    return clipped, total
+
+
+clip_grad_norm_ = clip_grad_norm

@@ -1,0 +1,180 @@
+"""Data-parallel gradient synchronization + train-step builder.
+
+≡ apex.parallel.DistributedDataParallel (apex/parallel/distributed.py:131-643)
+and Reducer (distributed.py:91-128).  The reference registers per-param
+autograd hooks, builds flat buckets on the fly, and overlaps NCCL
+allreduce with backward on dedicated streams.  Under XLA the same
+overlap is the compiler's job: the train step is ONE jitted SPMD program
+in which gradient `psum`s are scheduled concurrently with remaining
+backward compute (async collectives over ICI).  What remains of DDP is:
+
+  * `sync_gradients`  — pmean/psum over the dp axis (the semantic core)
+  * `sync_gradients_bucketed` — explicit flat-bucket parity mode
+    (≡ allreduce_bucket + multi_tensor_scale unflatten,
+    distributed.py:429-479), useful for collective-count parity tests
+  * `Reducer` — manual allreduce on demand (distributed.py:91-128)
+  * `make_train_step` — the user-facing builder that fuses forward,
+    backward, grad sync, loss scaling, and the fused optimizer into one
+    donated jitted step (≡ the whole hot loop of
+    examples/imagenet/main_amp.py:330-402)
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from apex_tpu import amp as amp_lib
+from apex_tpu.optimizers import flat as F
+from apex_tpu.parallel.mesh import DP_AXIS
+
+
+def sync_gradients(grads, axis_name: str = DP_AXIS, average: bool = True):
+    """All-reduce a grad pytree over the data-parallel axis.
+
+    ≡ DDP's bucketed allreduce with gradient_average=True
+    (apex/parallel/distributed.py:449-458).  Inside pjit/shard_map only.
+    """
+    op = jax.lax.pmean if average else jax.lax.psum
+    return jax.tree_util.tree_map(lambda g: op(g, axis_name), grads)
+
+
+def sync_gradients_bucketed(grads, axis_name: str = DP_AXIS,
+                            average: bool = True, num_buckets: int = 1):
+    """Flat-bucket allreduce parity mode ≡ allreduce_bucket
+    (distributed.py:429-479): flatten → allreduce buckets → unflatten.
+    On TPU this changes collective granularity only (XLA fuses either
+    way); kept for parity testing against the reference's bucket math.
+    """
+    spec = F.make_spec(grads)
+    flat = F.flatten(grads, jnp.float32)
+    n = flat.shape[0]
+    per = -(-n // num_buckets)
+    pieces = []
+    for b in range(num_buckets):
+        piece = jax.lax.dynamic_slice(
+            flat, (b * per,), (min(per, max(0, n - b * per)) or 1,)
+        ) if b * per < n else None
+        if piece is not None:
+            red = jax.lax.pmean(piece, axis_name) if average else \
+                jax.lax.psum(piece, axis_name)
+            pieces.append(red)
+    flat = jnp.concatenate(pieces)[:n]
+    return F.unflatten(flat, spec)
+
+
+class Reducer:
+    """Manual allreduce helper ≡ apex.parallel.Reducer
+    (distributed.py:91-128): call .reduce(tree) inside the SPMD region
+    whenever you want averaging."""
+
+    def __init__(self, axis_name: str = DP_AXIS):
+        self.axis_name = axis_name
+
+    def reduce(self, tree):
+        return sync_gradients(tree, self.axis_name, average=True)
+
+
+class DistributedDataParallel:
+    """Facade ≡ apex.parallel.DistributedDataParallel (distributed.py:131).
+
+    Wraps an apply function; `.apply` runs the module, `.sync` averages
+    grads over dp.  The reference's delay_allreduce / bucket knobs map to
+    `bucketed`/`num_buckets` (collective granularity) — overlap itself
+    is XLA-scheduled.
+    """
+
+    def __init__(self, apply_fn: Callable, axis_name: str = DP_AXIS,
+                 gradient_average: bool = True, bucketed: bool = False,
+                 num_buckets: int = 1):
+        self.apply_fn = apply_fn
+        self.axis_name = axis_name
+        self.gradient_average = gradient_average
+        self.bucketed = bucketed
+        self.num_buckets = num_buckets
+
+    def apply(self, params, *args, **kwargs):
+        return self.apply_fn(params, *args, **kwargs)
+
+    __call__ = apply
+
+    def sync(self, grads):
+        if self.bucketed:
+            return sync_gradients_bucketed(
+                grads, self.axis_name, self.gradient_average,
+                self.num_buckets)
+        return sync_gradients(grads, self.axis_name,
+                              self.gradient_average)
+
+
+def make_train_step(loss_fn: Callable, optimizer, mesh, *,
+                    amp_state: Optional[amp_lib.AmpState] = None,
+                    axis_name: str = DP_AXIS, donate: bool = True,
+                    batch_spec=None, has_aux: bool = False):
+    """Build the fused data-parallel train step.
+
+    `loss_fn(params, batch) -> loss` (or `(loss, aux)` with has_aux) is
+    differentiated per-shard; grads are pmean'd over `axis_name`; the
+    fused optimizer applies the update with loss-scaling/overflow-skip
+    fused in.  Returns `step(opt_state, amp_scaler_state, batch) ->
+    (params, opt_state, scaler_state, loss[, aux])`, jitted over `mesh`
+    with batch sharded on dp.
+
+    ≡ the reference hot loop: DDP.forward → amp.scale_loss → backward
+    hooks/allreduce → FusedAdam.step (SURVEY §3.2-3.3), collapsed into
+    one compiled program.
+    """
+    from jax import shard_map
+
+    policy = amp_state.policy if amp_state is not None else None
+    dynamic = amp_state.dynamic if amp_state is not None else False
+
+    def local_step(opt_state, scaler_state, batch):
+        params = F.unflatten(opt_state.params, optimizer.spec)
+        if policy is not None:
+            params = policy.cast_to_param(params)
+
+        def scaled_loss_fn(p, b):
+            out = loss_fn(p, b)
+            loss = out[0] if has_aux else out
+            scaled = loss * scaler_state.scale if scaler_state is not None \
+                else loss
+            return scaled, (out[1] if has_aux else None, loss)
+
+        grads, (aux, loss) = jax.grad(scaled_loss_fn, has_aux=True)(
+            params, batch)
+        grads = sync_gradients(grads, axis_name, average=True)
+
+        if scaler_state is not None:
+            inv = 1.0 / scaler_state.scale
+            found_inf = amp_lib.scaler.check_finite(grads)
+            new_scaler = amp_lib.scaler.update(scaler_state, found_inf,
+                                               dynamic=dynamic)
+        else:
+            inv = 1.0
+            found_inf = jnp.zeros((), bool)
+            new_scaler = None
+
+        new_params, new_opt_state = optimizer.step(
+            opt_state, grads, inv_scale=inv, found_inf=found_inf)
+        if has_aux:
+            return new_opt_state, new_scaler, loss, aux
+        return new_opt_state, new_scaler, loss
+
+    # batch sharded over dp; params/opt state replicated (ZeRO variants
+    # shard them — see optimizers/distributed_fused_adam.py)
+    if batch_spec is None:
+        batch_spec = P(axis_name)
+
+    smapped = shard_map(
+        local_step, mesh=mesh,
+        in_specs=(P(), P(), batch_spec),
+        out_specs=(P(), P(), P()) + ((P(),) if has_aux else ()),
+        check_vma=False)
+
+    donate_args = (0,) if donate else ()
+    return jax.jit(smapped, donate_argnums=donate_args)

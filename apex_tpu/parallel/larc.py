@@ -1,0 +1,77 @@
+"""LARC — layer-wise adaptive rate control.
+
+≡ apex.parallel.LARC (apex/parallel/LARC.py:5,78): wraps an inner
+optimizer; before each step it rescales every parameter tensor's grad by
+local_lr = trust_coefficient * ||p|| / (||g|| + wd*||p||), clipped to the
+base lr in `clip` mode.  Weight decay is folded into the scaled grad
+exactly like the reference (LARC.py:97-105).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def larc_adjust_grads(params, grads, lr, *, trust_coefficient=0.02,
+                      clip=True, eps=1e-8, weight_decay=0.0):
+    """Return LARC-adjusted grads (per-tensor adaptive scaling)."""
+
+    def adjust(p, g):
+        pn = jnp.linalg.norm(p.astype(jnp.float32).ravel())
+        gn = jnp.linalg.norm(g.astype(jnp.float32).ravel())
+        local_lr = trust_coefficient * pn / (gn + weight_decay * pn + eps)
+        # skip adaptation when either norm is 0 (LARC.py:92-96)
+        local_lr = jnp.where((pn > 0) & (gn > 0), local_lr, 1.0)
+        if clip:
+            scale = jnp.minimum(local_lr / lr, 1.0)
+        else:
+            scale = local_lr / lr  # eta mode: lr_total = base_lr * local_lr
+        g32 = g.astype(jnp.float32) + weight_decay * p.astype(jnp.float32)
+        return (g32 * scale).astype(g.dtype)
+
+    return jax.tree_util.tree_map(adjust, params, grads)
+
+
+class LARC:
+    """Optimizer wrapper ≡ apex.parallel.LARC.
+
+    larc = LARC(FusedSGD(lr=...)); state = larc.init(params);
+    params, state = larc.step(state, grads).
+    """
+
+    def __init__(self, optimizer, trust_coefficient=0.02, clip=True,
+                 eps=1e-8):
+        self.optim = optimizer
+        self.trust_coefficient = trust_coefficient
+        self.clip = clip
+        self.eps = eps
+
+    @property
+    def spec(self):
+        return self.optim.spec
+
+    def init(self, params):
+        return self.optim.init(params)
+
+    def step(self, state, grads, lr=None, **kw):
+        from apex_tpu.optimizers import flat as F
+        lr_val = lr if lr is not None else self.optim.lr
+        params = F.unflatten(state.params, self.optim.spec)
+        wd = getattr(self.optim, "weight_decay", 0.0)
+        adjusted = larc_adjust_grads(
+            params, grads, lr_val,
+            trust_coefficient=self.trust_coefficient, clip=self.clip,
+            eps=self.eps, weight_decay=wd)
+        # weight decay already applied to grads (reference zeroes it in
+        # the wrapped optimizer during step, LARC.py:87-106)
+        saved_wd = getattr(self.optim, "weight_decay", None)
+        if saved_wd is not None:
+            self.optim.weight_decay = 0.0
+        try:
+            out = self.optim.step(state, adjusted, lr=lr, **kw)
+        finally:
+            if saved_wd is not None:
+                self.optim.weight_decay = saved_wd
+        return out

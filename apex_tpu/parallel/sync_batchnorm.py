@@ -1,0 +1,124 @@
+"""SyncBatchNorm — cross-replica batch normalization.
+
+≡ apex.parallel.SyncBatchNorm (apex/parallel/optimized_sync_batchnorm.py:9,
+kernel fn optimized_sync_batchnorm_kernel.py:7-119, fallback
+sync_batchnorm.py:9) and `convert_syncbn_model` (apex/parallel/__init__.py:21).
+The CUDA design: local Welford → all_gather stats → welford_parallel
+merge → BN fwd; backward all-reduces (sum_dy, sum_dy_xmu).  The TPU
+design: the Pallas stats kernel (ops/welford.py) plus ONE `lax.psum`
+merge inside the autodiff region — JAX differentiates through the psum,
+emitting exactly the reference's backward collectives.
+
+Also covers the reference's process-group BN variants
+(apex.contrib.groupbn BatchNorm2d_NHWC, apex.contrib.cudnn_gbn
+GroupBatchNorm2d): pass a sub-axis name (or axis index ranges via
+shard_map axis slicing) as `axis_name`.
+
+Layout is channels-last (NHWC), the native TPU conv layout (the
+reference's groupbn is NHWC too).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.ops import welford
+
+
+def sync_batch_norm(x, scale, bias, running_mean, running_var, *,
+                    training: bool = True, momentum: float = 0.1,
+                    eps: float = 1e-5, axis_name: Optional[str] = None,
+                    channel_axis: int = -1):
+    """Functional SyncBN.  Returns (y, new_running_mean, new_running_var).
+
+    ≡ SyncBatchnormFunction.forward
+    (apex/parallel/optimized_sync_batchnorm_kernel.py:10-92).  When
+    `axis_name` is set (inside shard_map/pjit over the mesh), batch
+    statistics are merged across that axis; backward collectives are
+    derived by AD.  Running stats use the merged mean and the *unbiased*
+    var like the reference (kernel.py:54-60).
+    """
+    chan = channel_axis % x.ndim
+    reduce_axes = tuple(a for a in range(x.ndim) if a != chan)
+    if training:
+        mean, var, count = welford.batch_stats(x, reduce_axes)
+        if axis_name is not None:
+            mean, var, count = welford.merge_stats(mean, var, count,
+                                                   axis_name)
+        count = jnp.asarray(count, jnp.float32)
+        unbiased = var * count / jnp.maximum(count - 1.0, 1.0)
+        new_rm = (1 - momentum) * running_mean + momentum * jax.lax.stop_gradient(mean)
+        new_rv = (1 - momentum) * running_var + momentum * jax.lax.stop_gradient(unbiased)
+    else:
+        mean, var = running_mean, running_var
+        new_rm, new_rv = running_mean, running_var
+
+    shape = [1] * x.ndim
+    shape[chan] = x.shape[chan]
+    mean_b = mean.reshape(shape)
+    rstd_b = jax.lax.rsqrt(var + eps).reshape(shape)
+    y = (x.astype(jnp.float32) - mean_b) * rstd_b
+    if scale is not None:
+        y = y * scale.astype(jnp.float32).reshape(shape)
+    if bias is not None:
+        y = y + bias.astype(jnp.float32).reshape(shape)
+    return y.astype(x.dtype), new_rm, new_rv
+
+
+class SyncBatchNorm:
+    """Module facade ≡ apex.parallel.SyncBatchNorm
+    (optimized_sync_batchnorm.py:9-79).
+
+    params: {"scale": (C,), "bias": (C,)}; state: {"running_mean",
+    "running_var", "num_batches_tracked"}.
+    """
+
+    def __init__(self, num_features: int, eps: float = 1e-5,
+                 momentum: float = 0.1, affine: bool = True,
+                 track_running_stats: bool = True,
+                 axis_name: Optional[str] = None,
+                 channel_axis: int = -1):
+        self.num_features = num_features
+        self.eps = eps
+        self.momentum = momentum
+        self.affine = affine
+        self.track_running_stats = track_running_stats
+        self.axis_name = axis_name
+        self.channel_axis = channel_axis
+
+    def init(self, key=None, dtype=jnp.float32):
+        params = {}
+        if self.affine:
+            params = {"scale": jnp.ones((self.num_features,), dtype),
+                      "bias": jnp.zeros((self.num_features,), dtype)}
+        state = {"running_mean": jnp.zeros((self.num_features,), jnp.float32),
+                 "running_var": jnp.ones((self.num_features,), jnp.float32)}
+        return params, state
+
+    def apply(self, params, state, x, training: bool = True,
+              axis_name: Optional[str] = "__unset__"):
+        ax = self.axis_name if axis_name == "__unset__" else axis_name
+        scale = params.get("scale") if self.affine else None
+        bias = params.get("bias") if self.affine else None
+        y, rm, rv = sync_batch_norm(
+            x, scale, bias, state["running_mean"], state["running_var"],
+            training=training and self.track_running_stats or training,
+            momentum=self.momentum, eps=self.eps, axis_name=ax,
+            channel_axis=self.channel_axis)
+        new_state = {"running_mean": rm, "running_var": rv}
+        return y, new_state
+
+
+def convert_syncbn_model(module_tree, axis_name: str):
+    """≡ apex.parallel.convert_syncbn_model (apex/parallel/__init__.py:21):
+    walk a module pytree and give every SyncBatchNorm the DP axis name."""
+    def convert(m):
+        if isinstance(m, SyncBatchNorm):
+            m.axis_name = axis_name
+        return m
+    return jax.tree_util.tree_map(
+        convert, module_tree,
+        is_leaf=lambda m: isinstance(m, SyncBatchNorm))

@@ -1,0 +1,140 @@
+"""Data-parallel layer tests ≡ tests/distributed/DDP + amp_master_params:
+grad sync correctness (analytic), bucketed == unbucketed, and the fused
+train step trains a model identically to single-device full-batch SGD.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu import amp
+from apex_tpu.optimizers.fused_sgd import FusedSGD
+from apex_tpu.parallel import ddp
+from apex_tpu.parallel import mesh as M
+from apex_tpu.parallel.clip_grad import clip_grad_norm
+from apex_tpu.parallel.larc import LARC
+
+
+def test_sync_gradients_analytic():
+    """≡ ddp_race_condition_test.py:44-62 — analytically known grads."""
+    mesh = M.initialize_model_parallel()
+    g = jnp.arange(8.0).reshape(8, 1)  # rank r holds value r
+
+    f = shard_map(lambda x: ddp.sync_gradients({"g": x}, "dp")["g"],
+                  mesh=mesh, in_specs=P("dp"), out_specs=P("dp"),
+                  check_vma=False)
+    out = f(g)
+    np.testing.assert_allclose(np.asarray(out), 3.5)  # mean(0..7)
+
+
+def test_bucketed_matches_plain():
+    mesh = M.initialize_model_parallel()
+    tree = {"a": jnp.arange(24.0).reshape(8, 3),
+            "b": jnp.arange(8.0).reshape(8, 1) * 2}
+
+    def plain(t):
+        return ddp.sync_gradients(t, "dp")
+
+    def bucketed(t):
+        return ddp.sync_gradients_bucketed(t, "dp", num_buckets=2)
+
+    f1 = shard_map(plain, mesh=mesh, in_specs=P("dp"), out_specs=P("dp"),
+                   check_vma=False)
+    f2 = shard_map(bucketed, mesh=mesh, in_specs=P("dp"), out_specs=P("dp"),
+                   check_vma=False)
+    o1, o2 = f1(tree), f2(tree)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(np.asarray(a),
+                                                np.asarray(b), rtol=1e-6),
+        o1, o2)
+
+
+def test_make_train_step_matches_full_batch():
+    mesh = M.initialize_model_parallel()  # dp=8
+    k = jax.random.PRNGKey(0)
+    w_true = jnp.array([[2.0], [-3.0]])
+    X = jax.random.normal(k, (32, 2))
+    Y = X @ w_true
+
+    def loss_fn(params, batch):
+        x, y = batch
+        pred = x @ params["w"]
+        return jnp.mean((pred - y) ** 2)
+
+    params0 = {"w": jnp.zeros((2, 1))}
+
+    # sharded training
+    opt = FusedSGD(lr=0.1, use_pallas=False)
+    state = opt.init(params0)
+    step = ddp.make_train_step(loss_fn, opt, mesh,
+                               batch_spec=(P("dp"), P("dp")))
+    losses = []
+    for _ in range(10):
+        state, _, loss = step(state, None, (X, Y))
+        losses.append(float(loss))
+
+    # single-device full-batch reference
+    opt2 = FusedSGD(lr=0.1, use_pallas=False)
+    state2 = opt2.init(params0)
+    for _ in range(10):
+        grads = jax.grad(loss_fn)(
+            __import__("apex_tpu.optimizers.flat", fromlist=["unflatten"])
+            .unflatten(state2.params, opt2.spec), (X, Y))
+        _, state2 = opt2.step(state2, grads)
+
+    np.testing.assert_allclose(np.asarray(state.params),
+                               np.asarray(state2.params), rtol=1e-5,
+                               atol=1e-6)
+    assert losses[-1] < losses[0] * 0.1
+
+
+def test_make_train_step_with_amp_dynamic_scaling():
+    mesh = M.initialize_model_parallel()
+    X = jax.random.normal(jax.random.PRNGKey(1), (16, 4))
+    Y = jnp.sum(X, axis=1, keepdims=True)
+
+    def loss_fn(params, batch):
+        x, y = batch
+        return jnp.mean((x @ params["w"] - y) ** 2)
+
+    params0 = {"w": jnp.zeros((4, 1))}
+    opt = FusedSGD(lr=0.05, use_pallas=False)
+    state = opt.init(params0)
+    amp_state = amp.initialize(opt_level="O1")
+    scaler_state = amp_state.loss_scalers[0]
+    step = ddp.make_train_step(loss_fn, opt, mesh, amp_state=amp_state,
+                               batch_spec=(P("dp"), P("dp")))
+    losses = []
+    for _ in range(15):
+        state, scaler_state, loss = step(state, scaler_state, (X, Y))
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.5
+    assert float(scaler_state.scale) == 2.0 ** 16  # no overflow happened
+
+
+def test_clip_grad_norm():
+    grads = {"a": jnp.full((10,), 3.0), "b": jnp.full((6,), 4.0)}
+    clipped, total = clip_grad_norm(grads, max_norm=1.0)
+    expect_total = np.sqrt(10 * 9 + 6 * 16)
+    np.testing.assert_allclose(float(total), expect_total, rtol=1e-5)
+    flat = np.concatenate([np.asarray(clipped["a"]),
+                           np.asarray(clipped["b"])])
+    np.testing.assert_allclose(np.linalg.norm(flat), 1.0, rtol=1e-4)
+    # no-op below threshold
+    c2, _ = clip_grad_norm(grads, max_norm=1e9)
+    np.testing.assert_allclose(np.asarray(c2["a"]), 3.0)
+
+
+def test_larc_clip_mode():
+    params = {"w": jnp.full((4,), 2.0)}
+    opt = FusedSGD(lr=0.1, use_pallas=False)
+    larc = LARC(opt, trust_coefficient=0.02, clip=True)
+    state = larc.init(params)
+    grads = {"w": jnp.full((4,), 1.0)}
+    new_params, _ = larc.step(state, grads)
+    # local_lr = 0.02*||p||/||g|| = 0.02*4/2 = 0.04 < lr → scale=0.04/0.1
+    expect = 2.0 - 0.1 * (0.04 / 0.1) * 1.0
+    np.testing.assert_allclose(np.asarray(new_params["w"]), expect,
+                               rtol=1e-5)

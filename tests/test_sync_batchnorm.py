@@ -1,0 +1,109 @@
+"""SyncBatchNorm numerics vs single-device BN over the full batch.
+
+≡ tests/distributed/synced_batchnorm/*.py — the defining property: BN
+with stats merged across the dp axis equals BN over the unsharded batch,
+forward and backward.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu.ops import welford
+from apex_tpu.parallel import mesh as M
+from apex_tpu.parallel.sync_batchnorm import SyncBatchNorm, sync_batch_norm
+
+
+def _reference_bn(x, scale, bias, eps=1e-5):
+    mean = x.mean(axis=(0, 1, 2))
+    var = x.var(axis=(0, 1, 2))
+    y = (x - mean) * jax.lax.rsqrt(var + eps)
+    return y * scale + bias
+
+
+def test_channel_sums_pallas_parity():
+    x = jax.random.normal(jax.random.PRNGKey(0), (37, 16))
+    import apex_tpu.ops._common as common
+    old = common._FORCE
+    common._FORCE = "1"
+    try:
+        s, q = welford.channel_sums(x)
+    finally:
+        common._FORCE = old
+    np.testing.assert_allclose(np.asarray(s), np.asarray(x.sum(0)),
+                               rtol=1e-5, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(q), np.asarray((x * x).sum(0)),
+                               rtol=1e-5, atol=1e-4)
+
+
+def test_syncbn_matches_full_batch():
+    mesh = M.initialize_model_parallel()  # dp=8
+    N, H, W, C = 16, 4, 4, 6
+    x = jax.random.normal(jax.random.PRNGKey(1), (N, H, W, C))
+    scale = jnp.linspace(0.5, 1.5, C)
+    bias = jnp.linspace(-1, 1, C)
+    rm = jnp.zeros((C,))
+    rv = jnp.ones((C,))
+
+    def local(xl):
+        y, nrm, nrv = sync_batch_norm(xl, scale, bias, rm, rv,
+                                      training=True, axis_name="dp")
+        return y, nrm, nrv
+
+    f = shard_map(local, mesh=mesh, in_specs=P("dp"),
+                  out_specs=(P("dp"), P(), P()), check_vma=False)
+    y, nrm, nrv = f(x)
+    want = _reference_bn(x, scale, bias)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(want),
+                               rtol=1e-4, atol=1e-4)
+    # running stats: momentum 0.1, unbiased var
+    n = N * H * W
+    np.testing.assert_allclose(
+        np.asarray(nrm), 0.1 * np.asarray(x.mean(axis=(0, 1, 2))),
+        rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(nrv),
+        0.9 + 0.1 * np.asarray(x.var(axis=(0, 1, 2))) * n / (n - 1),
+        rtol=1e-4, atol=1e-5)
+
+
+def test_syncbn_backward_matches_full_batch():
+    mesh = M.initialize_model_parallel()
+    N, C = 16, 4
+    x = jax.random.normal(jax.random.PRNGKey(2), (N, 3, 3, C))
+    scale = jnp.ones((C,)) * 1.3
+    bias = jnp.zeros((C,))
+    rm, rv = jnp.zeros((C,)), jnp.ones((C,))
+    t = jax.random.normal(jax.random.PRNGKey(3), x.shape)
+
+    def sharded_loss(x, scale, bias, t):
+        def local(xl, s, b, tl):
+            y, _, _ = sync_batch_norm(xl, s, b, rm, rv, training=True,
+                                      axis_name="dp")
+            return jax.lax.psum(jnp.sum(y * tl), "dp")
+        f = shard_map(local, mesh=mesh,
+                      in_specs=(P("dp"), P(), P(), P("dp")),
+                      out_specs=P(), check_vma=False)
+        return f(x, scale, bias, t)
+
+    def ref_loss(x, scale, bias, t):
+        return jnp.sum(_reference_bn(x, scale, bias) * t)
+
+    g1 = jax.grad(sharded_loss, argnums=(0, 1, 2))(x, scale, bias, t)
+    g2 = jax.grad(ref_loss, argnums=(0, 1, 2))(x, scale, bias, t)
+    for a, e in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(e),
+                                   rtol=1e-3, atol=1e-4)
+
+
+def test_syncbn_module_eval_mode():
+    bn = SyncBatchNorm(5)
+    params, state = bn.init()
+    x = jax.random.normal(jax.random.PRNGKey(4), (3, 2, 2, 5)) * 2 + 1
+    y, new_state = bn.apply(params, state, x, training=False, axis_name=None)
+    # eval mode: normalize with running stats (0 mean, 1 var) → identity
+    np.testing.assert_allclose(np.asarray(y), np.asarray(x), rtol=1e-4,
+                               atol=1e-5)
+    np.testing.assert_allclose(np.asarray(new_state["running_mean"]), 0.0)
