@@ -1,0 +1,12 @@
+"""apex_tpu.models — flagship end-to-end models (≡ the reference's
+examples/ + apex/transformer/testing standalone models)."""
+
+
+def __getattr__(name):
+    import importlib
+    if name in ("resnet", "gpt", "bert"):
+        return importlib.import_module(f"apex_tpu.models.{name}")
+    if name in ("ResNet", "resnet50", "resnet18"):
+        return getattr(importlib.import_module("apex_tpu.models.resnet"),
+                       name)
+    raise AttributeError(name)

@@ -1,0 +1,221 @@
+"""ResNet (18/34/50/101/152) — the flagship CNN, NHWC, TPU-first.
+
+≡ the reference's canonical end-to-end model: torchvision resnet50
+driven by examples/imagenet/main_amp.py (AMP + DDP + SyncBN), plus the
+fused bottleneck block of apex.contrib.bottleneck
+(apex/contrib/bottleneck/bottleneck.py:134) — on TPU the conv+BN+ReLU
+chains are XLA-fused; the block structure here mirrors the contrib
+Bottleneck so the SpatialBottleneck halo variant (parallel/collectives
+halo_exchange_1d) drops in.
+
+Layout: NHWC (TPU-native conv layout).  BatchNorm is SyncBatchNorm with
+an optional dp axis name — pass axis_name=None for local BN.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from apex_tpu.parallel.sync_batchnorm import sync_batch_norm
+
+
+def conv2d(x, w, stride=1, padding="SAME"):
+    """NHWC conv; weights HWIO.  bf16 inputs hit the MXU directly."""
+    return lax.conv_general_dilated(
+        x, w, window_strides=(stride, stride), padding=padding,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+
+def _conv_init(key, kh, kw, cin, cout, dtype):
+    fan_in = kh * kw * cin
+    std = jnp.sqrt(2.0 / fan_in)  # kaiming normal ≡ torchvision init
+    return jax.random.normal(key, (kh, kw, cin, cout), dtype) * std
+
+
+def _bn_init(c):
+    return ({"scale": jnp.ones((c,)), "bias": jnp.zeros((c,))},
+            {"running_mean": jnp.zeros((c,)), "running_var": jnp.ones((c,))})
+
+
+def _bn_apply(params, state, x, training, axis_name, eps=1e-5,
+              momentum=0.1):
+    y, rm, rv = sync_batch_norm(
+        x, params["scale"], params["bias"], state["running_mean"],
+        state["running_var"], training=training, momentum=momentum,
+        eps=eps, axis_name=axis_name)
+    return y, {"running_mean": rm, "running_var": rv}
+
+
+class Bottleneck:
+    """1x1 → 3x3 → 1x1 with residual ≡ torchvision Bottleneck /
+    apex.contrib.bottleneck.Bottleneck (bottleneck.py:134)."""
+
+    expansion = 4
+
+    def __init__(self, cin, width, stride=1, downsample=False):
+        self.cin = cin
+        self.width = width
+        self.stride = stride
+        self.downsample = downsample
+        self.cout = width * self.expansion
+
+    def init(self, key, dtype=jnp.float32):
+        ks = jax.random.split(key, 4)
+        params, state = {}, {}
+        params["conv1"] = _conv_init(ks[0], 1, 1, self.cin, self.width, dtype)
+        params["bn1"], state["bn1"] = _bn_init(self.width)
+        params["conv2"] = _conv_init(ks[1], 3, 3, self.width, self.width, dtype)
+        params["bn2"], state["bn2"] = _bn_init(self.width)
+        params["conv3"] = _conv_init(ks[2], 1, 1, self.width, self.cout, dtype)
+        params["bn3"], state["bn3"] = _bn_init(self.cout)
+        # zero-init last BN scale ≡ torchvision zero_init_residual /
+        # main_amp.py training recipe
+        params["bn3"]["scale"] = jnp.zeros_like(params["bn3"]["scale"])
+        if self.downsample:
+            params["conv_ds"] = _conv_init(ks[3], 1, 1, self.cin, self.cout,
+                                           dtype)
+            params["bn_ds"], state["bn_ds"] = _bn_init(self.cout)
+        return params, state
+
+    def apply(self, params, state, x, training, axis_name):
+        new_state = {}
+        out = conv2d(x, params["conv1"])
+        out, new_state["bn1"] = _bn_apply(params["bn1"], state["bn1"], out,
+                                          training, axis_name)
+        out = jnp.maximum(out, 0)
+        out = conv2d(out, params["conv2"], stride=self.stride)
+        out, new_state["bn2"] = _bn_apply(params["bn2"], state["bn2"], out,
+                                          training, axis_name)
+        out = jnp.maximum(out, 0)
+        out = conv2d(out, params["conv3"])
+        out, new_state["bn3"] = _bn_apply(params["bn3"], state["bn3"], out,
+                                          training, axis_name)
+        if self.downsample:
+            sc = conv2d(x, params["conv_ds"], stride=self.stride)
+            sc, new_state["bn_ds"] = _bn_apply(params["bn_ds"],
+                                               state["bn_ds"], sc,
+                                               training, axis_name)
+        else:
+            sc = x
+        return jnp.maximum(out + sc, 0), new_state
+
+
+class BasicBlock:
+    expansion = 1
+
+    def __init__(self, cin, width, stride=1, downsample=False):
+        self.cin = cin
+        self.width = width
+        self.stride = stride
+        self.downsample = downsample
+        self.cout = width
+
+    def init(self, key, dtype=jnp.float32):
+        ks = jax.random.split(key, 3)
+        params, state = {}, {}
+        params["conv1"] = _conv_init(ks[0], 3, 3, self.cin, self.width, dtype)
+        params["bn1"], state["bn1"] = _bn_init(self.width)
+        params["conv2"] = _conv_init(ks[1], 3, 3, self.width, self.width, dtype)
+        params["bn2"], state["bn2"] = _bn_init(self.width)
+        params["bn2"]["scale"] = jnp.zeros_like(params["bn2"]["scale"])
+        if self.downsample:
+            params["conv_ds"] = _conv_init(ks[2], 1, 1, self.cin, self.cout,
+                                           dtype)
+            params["bn_ds"], state["bn_ds"] = _bn_init(self.cout)
+        return params, state
+
+    def apply(self, params, state, x, training, axis_name):
+        new_state = {}
+        out = conv2d(x, params["conv1"], stride=self.stride)
+        out, new_state["bn1"] = _bn_apply(params["bn1"], state["bn1"], out,
+                                          training, axis_name)
+        out = jnp.maximum(out, 0)
+        out = conv2d(out, params["conv2"])
+        out, new_state["bn2"] = _bn_apply(params["bn2"], state["bn2"], out,
+                                          training, axis_name)
+        if self.downsample:
+            sc = conv2d(x, params["conv_ds"], stride=self.stride)
+            sc, new_state["bn_ds"] = _bn_apply(params["bn_ds"],
+                                               state["bn_ds"], sc,
+                                               training, axis_name)
+        else:
+            sc = x
+        return jnp.maximum(out + sc, 0), new_state
+
+
+_CONFIGS = {
+    "resnet10": (BasicBlock, (1, 1, 1, 1)),  # test/CI stand-in
+    "resnet18": (BasicBlock, (2, 2, 2, 2)),
+    "resnet34": (BasicBlock, (3, 4, 6, 3)),
+    "resnet50": (Bottleneck, (3, 4, 6, 3)),
+    "resnet101": (Bottleneck, (3, 4, 23, 3)),
+    "resnet152": (Bottleneck, (3, 8, 36, 3)),
+}
+
+
+class ResNet:
+    def __init__(self, arch: str = "resnet50", num_classes: int = 1000,
+                 axis_name: Optional[str] = None, small_input: bool = False):
+        block_cls, layers = _CONFIGS[arch]
+        self.arch = arch
+        self.num_classes = num_classes
+        self.axis_name = axis_name
+        self.small_input = small_input  # CIFAR stand-in: 3x3 stem, no pool
+        self.blocks = []
+        cin = 64
+        for stage, n in enumerate(layers):
+            width = 64 * (2 ** stage)
+            for i in range(n):
+                stride = 2 if (stage > 0 and i == 0) else 1
+                downsample = (i == 0 and (stride != 1 or
+                                          cin != width * block_cls.expansion))
+                blk = block_cls(cin, width, stride, downsample)
+                self.blocks.append(blk)
+                cin = blk.cout
+        self.feat_dim = cin
+
+    def init(self, key, dtype=jnp.float32):
+        ks = jax.random.split(key, len(self.blocks) + 2)
+        params, state = {}, {}
+        stem_k = 3 if self.small_input else 7
+        params["conv_stem"] = _conv_init(ks[0], stem_k, stem_k, 3, 64, dtype)
+        params["bn_stem"], state["bn_stem"] = _bn_init(64)
+        for i, blk in enumerate(self.blocks):
+            params[f"block{i}"], state[f"block{i}"] = blk.init(ks[i + 1],
+                                                               dtype)
+        params["fc_w"] = jax.random.normal(
+            ks[-1], (self.feat_dim, self.num_classes), dtype) * 0.01
+        params["fc_b"] = jnp.zeros((self.num_classes,), dtype)
+        return params, state
+
+    def apply(self, params, state, x, training: bool = True,
+              axis_name="__unset__"):
+        ax = self.axis_name if axis_name == "__unset__" else axis_name
+        new_state = {}
+        stride = 1 if self.small_input else 2
+        h = conv2d(x, params["conv_stem"], stride=stride)
+        h, new_state["bn_stem"] = _bn_apply(params["bn_stem"],
+                                            state["bn_stem"], h, training, ax)
+        h = jnp.maximum(h, 0)
+        if not self.small_input:
+            h = lax.reduce_window(h, -jnp.inf, lax.max, (1, 3, 3, 1),
+                                  (1, 2, 2, 1), "SAME")
+        for i, blk in enumerate(self.blocks):
+            h, new_state[f"block{i}"] = blk.apply(
+                params[f"block{i}"], state[f"block{i}"], h, training, ax)
+        h = jnp.mean(h, axis=(1, 2))  # global average pool
+        logits = h @ params["fc_w"] + params["fc_b"]
+        return logits, new_state
+
+
+def resnet50(**kw):
+    return ResNet("resnet50", **kw)
+
+
+def resnet18(**kw):
+    return ResNet("resnet18", **kw)

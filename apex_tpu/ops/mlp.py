@@ -1,0 +1,64 @@
+"""Fused MLP — chain of linear(+bias)+activation layers.
+
+≡ the reference's `mlp_cuda` extension (csrc/mlp.cpp:163-164, epilogue
+kernels csrc/mlp_cuda.cu:437-950) and apex.mlp.MLP (apex/mlp/mlp.py:11-33):
+a cublas-GEMM chain with fused bias/ReLU/sigmoid epilogues.  On TPU the
+chain is the Pallas fused-dense kernel per layer (ops/fused_dense.py);
+XLA fuses the rest.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.ops.fused_dense import linear_bias
+
+
+def mlp_forward(x, weights, biases, activation: str = "relu",
+                use_pallas_override: Optional[bool] = None):
+    """Apply the MLP chain; activation on all layers but the last
+    (≡ mlp_cuda.forward semantics: MLP applies activation between
+    layers, none after the final one)."""
+    n = len(weights)
+    for i, (w, b) in enumerate(zip(weights, biases)):
+        act = activation if i < n - 1 else None
+        x = linear_bias(x, w, b, act, use_pallas_override)
+    return x
+
+
+class MLP:
+    """≡ apex.mlp.MLP (apex/mlp/mlp.py:33): mlp_sizes = [in, h1, ..., out].
+
+    activation: 'none' | 'relu' | 'sigmoid' (mlp.py:41-47).
+    """
+
+    def __init__(self, mlp_sizes: Sequence[int], bias: bool = True,
+                 activation: str = "relu"):
+        if activation not in ("none", "relu", "sigmoid", "gelu"):
+            raise TypeError(f"activation '{activation}' not supported")
+        self.mlp_sizes = tuple(mlp_sizes)
+        self.use_bias = bias
+        self.activation = activation
+
+    def init(self, key, dtype=jnp.float32):
+        params = {"weights": [], "biases": []}
+        for i in range(len(self.mlp_sizes) - 1)            :
+            key, k1, k2 = jax.random.split(key, 3)
+            fan_in, fan_out = self.mlp_sizes[i], self.mlp_sizes[i + 1]
+            # ≡ MLP.reset_parameters (mlp.py:63-70): kaiming-uniform-ish
+            bound = 1.0 / jnp.sqrt(fan_in)
+            params["weights"].append(
+                jax.random.uniform(k1, (fan_in, fan_out), dtype, -bound,
+                                   bound))
+            params["biases"].append(
+                jax.random.uniform(k2, (fan_out,), dtype, -bound, bound)
+                if self.use_bias else None)
+        return params
+
+    def apply(self, params, x, use_pallas_override=None):
+        act = self.activation if self.activation != "none" else None
+        return mlp_forward(x, params["weights"], params["biases"],
+                           act or "none", use_pallas_override)

@@ -114,15 +114,18 @@ class DistributedDataParallel:
 def make_train_step(loss_fn: Callable, optimizer, mesh, *,
                     amp_state: Optional[amp_lib.AmpState] = None,
                     axis_name: str = DP_AXIS, donate: bool = True,
-                    batch_spec=None, has_aux: bool = False):
+                    batch_spec=None, has_aux: bool = False,
+                    with_state: bool = False):
     """Build the fused data-parallel train step.
 
-    `loss_fn(params, batch) -> loss` (or `(loss, aux)` with has_aux) is
-    differentiated per-shard; grads are pmean'd over `axis_name`; the
-    fused optimizer applies the update with loss-scaling/overflow-skip
-    fused in.  Returns `step(opt_state, amp_scaler_state, batch) ->
-    (params, opt_state, scaler_state, loss[, aux])`, jitted over `mesh`
-    with batch sharded on dp.
+    `loss_fn(params, batch) -> loss` (or `(loss, aux)` with has_aux;
+    with with_state: `loss_fn(params, model_state, batch) ->
+    (loss, new_model_state)`, e.g. BN batch stats) is differentiated
+    per-shard; grads are pmean'd over `axis_name`; the fused optimizer
+    applies the update with loss-scaling/overflow-skip fused in.
+    Returns `step(opt_state, amp_scaler_state[, model_state], batch) ->
+    (opt_state, scaler_state[, model_state], loss[, aux])`, jitted over
+    `mesh` with batch sharded on dp.
 
     ≡ the reference hot loop: DDP.forward → amp.scale_loss → backward
     hooks/allreduce → FusedAdam.step (SURVEY §3.2-3.3), collapsed into
@@ -133,17 +136,22 @@ def make_train_step(loss_fn: Callable, optimizer, mesh, *,
     policy = amp_state.policy if amp_state is not None else None
     dynamic = amp_state.dynamic if amp_state is not None else False
 
-    def local_step(opt_state, scaler_state, batch):
+    def local_step(opt_state, scaler_state, model_state, batch):
         params = F.unflatten(opt_state.params, optimizer.spec)
         if policy is not None:
             params = policy.cast_to_param(params)
 
         def scaled_loss_fn(p, b):
-            out = loss_fn(p, b)
-            loss = out[0] if has_aux else out
+            if with_state:
+                loss, new_mstate = loss_fn(p, model_state, b)
+                aux = new_mstate
+            else:
+                out = loss_fn(p, b)
+                loss = out[0] if has_aux else out
+                aux = out[1] if has_aux else None
             scaled = loss * scaler_state.scale if scaler_state is not None \
                 else loss
-            return scaled, (out[1] if has_aux else None, loss)
+            return scaled, (aux, loss)
 
         grads, (aux, loss) = jax.grad(scaled_loss_fn, has_aux=True)(
             params, batch)
@@ -161,20 +169,39 @@ def make_train_step(loss_fn: Callable, optimizer, mesh, *,
 
         new_params, new_opt_state = optimizer.step(
             opt_state, grads, inv_scale=inv, found_inf=found_inf)
-        if has_aux:
-            return new_opt_state, new_scaler, loss, aux
-        return new_opt_state, new_scaler, loss
+        outs = (new_opt_state, new_scaler)
+        if with_state:
+            outs = outs + (aux,)
+        outs = outs + (loss,)
+        if has_aux and not with_state:
+            outs = outs + (aux,)
+        return outs
 
     # batch sharded over dp; params/opt state replicated (ZeRO variants
     # shard them — see optimizers/distributed_fused_adam.py)
     if batch_spec is None:
         batch_spec = P(axis_name)
 
+    out_specs = (P(), P())
+    if with_state:
+        out_specs += (P(),)
+    out_specs += (P(),)  # loss
+    if has_aux and not with_state:
+        out_specs += (P(),)
+
     smapped = shard_map(
         local_step, mesh=mesh,
-        in_specs=(P(), P(), batch_spec),
-        out_specs=(P(), P(), P()) + ((P(),) if has_aux else ()),
+        in_specs=(P(), P(), P(), batch_spec),
+        out_specs=out_specs,
         check_vma=False)
 
     donate_args = (0,) if donate else ()
-    return jax.jit(smapped, donate_argnums=donate_args)
+    jitted = jax.jit(smapped, donate_argnums=donate_args)
+
+    if with_state:
+        return jitted
+
+    def step(opt_state, scaler_state, batch):
+        return jitted(opt_state, scaler_state, None, batch)
+
+    return step

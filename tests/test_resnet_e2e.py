@@ -1,0 +1,69 @@
+"""End-to-end slice ≡ tests/L1 cross_product: ResNet (CIFAR stand-in)
+training with AMP opt-levels + DP mesh + SyncBN + fused optimizer —
+loss decreases, and O0 vs O1 trajectories agree (parity across
+opt-levels, ≡ tests/L1/common/compare.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu import amp
+from apex_tpu.models.resnet import ResNet
+from apex_tpu.ops.xentropy import softmax_cross_entropy_loss
+from apex_tpu.optimizers.fused_sgd import FusedSGD
+from apex_tpu.parallel import ddp
+from apex_tpu.parallel import mesh as M
+
+
+def _data(n=16, classes=10):
+    x = jax.random.normal(jax.random.PRNGKey(0), (n, 32, 32, 3))
+    y = jax.random.randint(jax.random.PRNGKey(1), (n,), 0, classes)
+    return x, y
+
+
+def _train(opt_level, steps=8):
+    mesh = M.initialize_model_parallel()  # dp=8
+    model = ResNet("resnet10", num_classes=10, axis_name="dp",
+                   small_input=True)
+    params, mstate = model.init(jax.random.PRNGKey(42))
+    amp_state = amp.initialize(opt_level=opt_level)
+    if amp_state.policy.param_dtype != jnp.float32:
+        params = amp.convert_network(params, amp_state.policy.param_dtype)
+
+    def loss_fn(p, ms, batch):
+        x, y = batch
+        logits, new_ms = model.apply(p, ms, x, training=True)
+        loss = jnp.mean(softmax_cross_entropy_loss(
+            logits.astype(jnp.float32), y))
+        return loss, new_ms
+
+    opt = FusedSGD(lr=0.1, momentum=0.9, use_pallas=False)
+    state = opt.init(params)
+    scaler = amp_state.loss_scalers[0]
+    step = ddp.make_train_step(loss_fn, opt, mesh, amp_state=amp_state,
+                               batch_spec=(P("dp"), P("dp")),
+                               with_state=True, donate=False)
+    x, y = _data()
+    losses = []
+    for _ in range(steps):
+        state, scaler, mstate, loss = step(state, scaler, mstate, (x, y))
+        losses.append(float(loss))
+    M.destroy_model_parallel()
+    return losses
+
+
+@pytest.mark.parametrize("opt_level", ["O0", "O1"])
+def test_resnet_trains(opt_level):
+    losses = _train(opt_level)
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0] * 0.8
+
+
+def test_opt_level_parity():
+    """O0 vs O1 loss trajectories stay within bf16 tolerance
+    (≡ tests/L1/common/compare.py:30-60 parity check)."""
+    l0 = _train("O0", steps=3)
+    l1 = _train("O1", steps=3)
+    np.testing.assert_allclose(l0, l1, rtol=5e-2, atol=5e-2)
