@@ -1,0 +1,28 @@
+"""apex_tpu.transformer.tensor_parallel ≡ apex/transformer/tensor_parallel:
+Megatron-style parallel layers, mappings, vocab-parallel cross entropy,
+data broadcast, RNG tracking, and activation-checkpoint helpers."""
+
+from apex_tpu.parallel.collectives import (  # noqa: F401  (≡ mappings.py)
+    copy_to_tensor_model_parallel_region,
+    gather_from_sequence_parallel_region,
+    gather_from_tensor_model_parallel_region,
+    reduce_from_tensor_model_parallel_region,
+    reduce_scatter_to_sequence_parallel_region,
+    scatter_to_sequence_parallel_region,
+    scatter_to_tensor_model_parallel_region,
+)
+from apex_tpu.transformer.tensor_parallel.layers import (  # noqa: F401
+    ColumnParallelLinear,
+    RowParallelLinear,
+    VocabParallelEmbedding,
+)
+from apex_tpu.transformer.tensor_parallel.cross_entropy import (  # noqa: F401
+    vocab_parallel_cross_entropy,
+)
+from apex_tpu.transformer.tensor_parallel.data import broadcast_data  # noqa: F401
+from apex_tpu.transformer.tensor_parallel.random import (  # noqa: F401
+    RNGStatesTracker,
+    checkpoint,
+    get_rng_tracker,
+    model_parallel_fold_in,
+)

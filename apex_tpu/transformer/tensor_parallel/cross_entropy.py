@@ -1,0 +1,62 @@
+"""Vocab-parallel cross entropy.
+
+≡ _VocabParallelCrossEntropy (apex/transformer/tensor_parallel/cross_entropy.py:23-129):
+logits are sharded over the vocab dim on the tp axis; the loss needs
+three collectives — max (pmax), sum-exp (psum), and the target-logit
+gather via a vocab-range mask (psum).  Label smoothing matches the
+reference (cross_entropy.py:100-118).  Backward is derived by AD through
+the collectives (the reference hand-writes it; XLA produces the same
+collective pattern).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from apex_tpu.parallel.collectives import (
+    reduce_from_tensor_model_parallel_region as _reduce)
+from apex_tpu.parallel.mesh import TP_AXIS
+
+
+def vocab_parallel_cross_entropy(local_logits, labels, smoothing: float = 0.0,
+                                 axis_name: str = TP_AXIS):
+    """Per-token loss from vocab-sharded logits.
+
+    local_logits: (..., V/p) this rank's shard; labels: (...) global ids.
+    """
+    x = local_logits.astype(jnp.float32)
+    vocab_per = x.shape[-1]
+    rank = lax.axis_index(axis_name)
+    start = rank * vocab_per
+
+    # stable logsumexp across shards; the max shift is stability-only so
+    # it is detached (pmax has no transpose rule; gradient is unchanged)
+    local_max = jnp.max(jax.lax.stop_gradient(x), axis=-1)
+    global_max = lax.pmax(local_max, axis_name)
+    # Reductions use the psum-fwd/identity-bwd pair (Megatron's "g" op,
+    # mappings.py:159-174): the loss is replicated across tp, so every
+    # rank seeds the same cotangent and each rank's backward must touch
+    # only its local shard — a raw lax.psum would double-count by tp.
+    x_shift = x - global_max[..., None]
+    local_sum = jnp.sum(jnp.exp(x_shift), axis=-1)
+    global_sum = _reduce(local_sum, axis_name)
+    lse = jnp.log(global_sum) + global_max
+
+    # target logit: mask ids outside this rank's range (cross_entropy.py:44-63)
+    local_ids = labels - start
+    valid = (local_ids >= 0) & (local_ids < vocab_per)
+    safe_ids = jnp.where(valid, local_ids, 0)
+    picked = jnp.take_along_axis(x, safe_ids[..., None], axis=-1)[..., 0]
+    target_logit = _reduce(jnp.where(valid, picked, 0.0), axis_name)
+
+    loss = lse - target_logit
+    if smoothing > 0:
+        # ≡ cross_entropy.py:100-118: mean log prob over the full vocab
+        vocab_size = vocab_per * lax.axis_size(axis_name)
+        sum_logits = _reduce(jnp.sum(x, axis=-1), axis_name)
+        mean_log_prob = sum_logits / vocab_size - lse
+        smooth_loss = -mean_log_prob
+        loss = (1.0 - smoothing) * loss + smoothing * smooth_loss
+    return loss

@@ -1,0 +1,38 @@
+"""Batch data distribution across the TP group.
+
+≡ apex/transformer/tensor_parallel/data.py broadcast_data (data.py:80):
+the reference torch-broadcasts tokenized batches from tp-rank-0 because
+each process loads data independently.  Under JAX's single-program SPMD,
+every host feeds the same global arrays and the partitioner distributes
+them — a broadcast is definitionally a no-op *within* a process.  What
+remains meaningful (and is implemented) is the reference's key/dtype
+validation, and a multi-host broadcast helper for when hosts load
+distinct data (jax.experimental.multihost_utils).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _check_data_types(keys, data, target_dtype):
+    """≡ data.py:17-27."""
+    for key in keys:
+        if data[key].dtype != target_dtype:
+            raise ValueError(
+                f"{key} has data type {data[key].dtype} which "
+                f"is different than {target_dtype}")
+
+
+def broadcast_data(keys, data, datatype=jnp.int32):
+    """≡ broadcast_data (data.py:80-115).  Validates dtypes and returns
+    the selected entries; under multi-host, routes through
+    multihost_utils so all hosts agree on rank-0's batch."""
+    _check_data_types(keys, data, datatype)
+    out = {k: jnp.asarray(data[k]) for k in keys}
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+        out = {k: multihost_utils.broadcast_one_to_all(v)
+               for k, v in out.items()}
+    return out

@@ -1,0 +1,195 @@
+"""Tensor-parallel layers — Column/Row parallel linear, vocab-parallel
+embedding.
+
+≡ apex/transformer/tensor_parallel/layers.py: VocabParallelEmbedding
+(174-276), ColumnParallelLinear (460-642), RowParallelLinear (645-813),
+and the fused LinearWithGradAccumulationAndAsyncCommunication autograd
+(217-430).  TPU re-design: the layers are shard-local pure functions
+intended to run inside `shard_map` over the global mesh; the Megatron
+collective semantics come from the custom_vjp pairs in
+parallel/collectives.py.  The reference's async-communication overlap
+(async grad allreduce overlapping wgrad, layers.py:344-375) is XLA's
+scheduler's job: collectives inside one jitted program are issued
+asynchronously over ICI automatically.
+
+Parameters are GLOBAL arrays with a `partition_spec()` per layer
+(tensor_model_parallel attributes ≡ layers.py:70-107 become
+PartitionSpecs); shard_map hands each device its shard.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu.parallel.collectives import (
+    copy_to_tensor_model_parallel_region,
+    gather_from_sequence_parallel_region,
+    gather_from_tensor_model_parallel_region,
+    reduce_from_tensor_model_parallel_region,
+    reduce_scatter_to_sequence_parallel_region,
+)
+from apex_tpu.parallel.mesh import TP_AXIS
+
+
+class ColumnParallelLinear:
+    """Y = XA + b with A column-sharded over tp: A = [A_1 .. A_p].
+
+    ≡ ColumnParallelLinear (layers.py:460-642).  gather_output re-gathers
+    Y along the last dim; sequence_parallel all-gathers the seq-sharded
+    input first (layers.py:311-324) — its backward is the reduce-scatter
+    of dgrad (405-413 via the collective's custom_vjp).
+    """
+
+    def __init__(self, input_size: int, output_size: int, *, bias: bool = True,
+                 gather_output: bool = False, sequence_parallel: bool = False,
+                 init_std: Optional[float] = None, axis_name: str = TP_AXIS):
+        self.input_size = input_size
+        self.output_size = output_size
+        self.use_bias = bias
+        self.gather_output = gather_output
+        self.sequence_parallel = sequence_parallel
+        self.init_std = init_std
+        self.axis_name = axis_name
+
+    def init(self, key, dtype=jnp.float32):
+        std = self.init_std or (1.0 / jnp.sqrt(self.input_size))
+        p = {"weight": jax.random.normal(
+            key, (self.input_size, self.output_size), dtype) * std}
+        if self.use_bias:
+            p["bias"] = jnp.zeros((self.output_size,), dtype)
+        return p
+
+    def partition_spec(self):
+        spec = {"weight": P(None, self.axis_name)}
+        if self.use_bias:
+            spec["bias"] = P(self.axis_name)
+        return spec
+
+    def apply(self, params, x):
+        """Shard-local: params are the LOCAL shards (out dim / tp)."""
+        ax = self.axis_name
+        if self.sequence_parallel:
+            x = gather_from_sequence_parallel_region(x, ax)
+        else:
+            x = copy_to_tensor_model_parallel_region(x, ax)
+        y = jnp.dot(x, params["weight"],
+                    preferred_element_type=jnp.float32).astype(x.dtype)
+        if self.use_bias:
+            y = y + params["bias"].astype(y.dtype)
+        if self.gather_output:
+            y = gather_from_tensor_model_parallel_region(y, ax)
+        return y
+
+
+class RowParallelLinear:
+    """Y = XA + b with A row-sharded over tp; partial results summed.
+
+    ≡ RowParallelLinear (layers.py:645-813).  input_is_parallel skips the
+    input scatter; sequence_parallel reduce-scatters the output along
+    the sequence dim instead of all-reducing (mappings.py:122-138).
+    Bias is added AFTER the reduction (once, not per-rank).
+    """
+
+    def __init__(self, input_size: int, output_size: int, *, bias: bool = True,
+                 input_is_parallel: bool = True,
+                 sequence_parallel: bool = False,
+                 init_std: Optional[float] = None, axis_name: str = TP_AXIS):
+        if sequence_parallel and not input_is_parallel:
+            raise RuntimeError(
+                "To enable sequence_parallel, input_is_parallel must be True")
+        self.input_size = input_size
+        self.output_size = output_size
+        self.use_bias = bias
+        self.input_is_parallel = input_is_parallel
+        self.sequence_parallel = sequence_parallel
+        self.init_std = init_std
+        self.axis_name = axis_name
+
+    def init(self, key, dtype=jnp.float32):
+        std = self.init_std or (1.0 / jnp.sqrt(self.input_size))
+        p = {"weight": jax.random.normal(
+            key, (self.input_size, self.output_size), dtype) * std}
+        if self.use_bias:
+            p["bias"] = jnp.zeros((self.output_size,), dtype)
+        return p
+
+    def partition_spec(self):
+        spec = {"weight": P(self.axis_name, None)}
+        if self.use_bias:
+            spec["bias"] = P()
+        return spec
+
+    def apply(self, params, x):
+        ax = self.axis_name
+        if not self.input_is_parallel:
+            from apex_tpu.parallel.collectives import (
+                scatter_to_tensor_model_parallel_region)
+            x = scatter_to_tensor_model_parallel_region(x, ax)
+        y = jnp.dot(x, params["weight"],
+                    preferred_element_type=jnp.float32).astype(x.dtype)
+        if self.sequence_parallel:
+            y = reduce_scatter_to_sequence_parallel_region(y, ax)
+        else:
+            y = reduce_from_tensor_model_parallel_region(y, ax)
+        if self.use_bias:
+            bias = params["bias"]
+            if self.sequence_parallel:
+                # replicated param consumed in a seq-sharded region: its
+                # grad is a partial sum per rank and must be psum'd over
+                # tp — ≡ the sequence_parallel_enabled param tagging +
+                # external allreduce (apex/transformer/layers/layer_norm.py:26-74)
+                bias = copy_to_tensor_model_parallel_region(bias, ax)
+            y = y + bias.astype(y.dtype)
+        return y
+
+
+class VocabParallelEmbedding:
+    """Embedding with the vocab dim sharded over tp.
+
+    ≡ VocabParallelEmbedding (layers.py:174-276): each rank owns rows
+    [rank*V/p, (rank+1)*V/p); out-of-range ids are masked to 0, looked
+    up locally, the masked outputs zeroed, and the result psum'd.
+    """
+
+    def __init__(self, num_embeddings: int, embedding_dim: int, *,
+                 init_std: float = 0.02, axis_name: str = TP_AXIS,
+                 sequence_parallel: bool = False):
+        self.num_embeddings = num_embeddings
+        self.embedding_dim = embedding_dim
+        self.init_std = init_std
+        self.axis_name = axis_name
+        self.sequence_parallel = sequence_parallel
+
+    def init(self, key, dtype=jnp.float32):
+        return {"weight": jax.random.normal(
+            key, (self.num_embeddings, self.embedding_dim), dtype)
+            * self.init_std}
+
+    def partition_spec(self):
+        return {"weight": P(self.axis_name, None)}
+
+    def apply(self, params, ids):
+        """Shard-local; params["weight"] is the LOCAL (V/p, D) shard.
+        ids: integer array (replicated or seq-sharded upstream)."""
+        ax = self.axis_name
+        w = params["weight"]
+        vocab_per = w.shape[0]
+        rank = lax.axis_index(ax)
+        start = rank * vocab_per
+        local_ids = ids - start
+        valid = (local_ids >= 0) & (local_ids < vocab_per)
+        local_ids = jnp.where(valid, local_ids, 0)
+        out = jnp.take(w, local_ids, axis=0)
+        out = jnp.where(valid[..., None], out, 0.0)
+        out = reduce_from_tensor_model_parallel_region(out, ax)
+        if self.sequence_parallel:
+            # embedding output scatter along seq (Megatron SP entry point)
+            from apex_tpu.parallel.collectives import (
+                scatter_to_sequence_parallel_region)
+            out = scatter_to_sequence_parallel_region(out, ax)
+        return out

@@ -1,0 +1,66 @@
+"""Preallocated memory buffers for checkpointed activations.
+
+≡ apex/transformer/tensor_parallel/memory.py MemoryBuffer/RingMemBuffer
+(37-146).  On TPU, XLA owns allocation: buffer reuse is achieved with
+donation + static shapes, so these classes are thin functional
+equivalents kept for API parity (chunked allocate-from-arena semantics
+without the manual pointer math).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+class MemoryBuffer:
+    """≡ MemoryBuffer (memory.py:37-107): fixed-size arena handing out
+    tensor views.  Functional version: tracks offsets, returns slices."""
+
+    def __init__(self, name, numel, dtype, track_usage=False):
+        self.name = name
+        self.numel = numel
+        self.dtype = dtype
+        self.data = jnp.zeros((numel,), dtype)
+        self._start = 0
+        self.in_use_value = 0
+        self.total_value = 0
+        self.track_usage = track_usage
+
+    def reset(self):
+        self._start = 0
+
+    def is_in_use(self):
+        return self._start > 0
+
+    def add(self, shape):
+        size = 1
+        for s in shape:
+            size *= int(s)
+        if self._start + size > self.numel:
+            raise RuntimeError("MemoryBuffer out of space")
+        view = self.data[self._start:self._start + size].reshape(shape)
+        self._start += size
+        if self.track_usage:
+            self.in_use_value += size
+            self.total_value += size
+        return view
+
+    def get_data(self):
+        return self.data
+
+
+class RingMemBuffer:
+    """≡ RingMemBuffer (memory.py:110-146): round-robin buffer pool."""
+
+    def __init__(self, name, num_buffers, numel, dtype, track_usage=False):
+        self.num_buffers = num_buffers
+        self.buffers = [MemoryBuffer(f"{name} {i}", numel, dtype, track_usage)
+                        for i in range(num_buffers)]
+        self._index = -1
+
+    def get_next_buffer(self):
+        self._index = (self._index + 1) % self.num_buffers
+        buf = self.buffers[self._index]
+        if buf.is_in_use():
+            raise RuntimeError("buffer is already in use")
+        return buf

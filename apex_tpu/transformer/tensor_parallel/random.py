@@ -1,0 +1,108 @@
+"""Parallel RNG management + activation checkpointing.
+
+≡ apex/transformer/tensor_parallel/random.py: CudaRNGStatesTracker
+(204-235) and CheckpointFunction (237-306).  The TPU translation:
+
+* CUDA RNG states → `jax.random` keys.  The Megatron rule "TP ranks
+  share a default seed but diverge on model-parallel-rng with
+  seed = base + 2718 + tp_rank" (random.py:248-261) becomes a fold_in
+  of the tp coordinate.
+* CheckpointFunction (recompute-in-backward with RNG state restore) →
+  `jax.checkpoint`: functional RNG keys make the fork/restore dance
+  unnecessary — passing the same key to the recomputation reproduces
+  dropout exactly.
+* distributed activation storage (split_tensor_into_1d_equal_chunks /
+  gather_split_1d_tensor, random.py:64-83) → psum_scatter/all_gather
+  helpers below.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from apex_tpu.parallel.mesh import TP_AXIS
+
+_MODEL_PARALLEL_RNG = "model-parallel-rng"
+
+
+def model_parallel_fold_in(key, axis_name: str = TP_AXIS):
+    """Per-tp-rank key ≡ seed + 2718 + tp_rank (random.py:248-261).
+    Use inside shard_map for rank-divergent init/dropout (TP linears)."""
+    return jax.random.fold_in(key, 2718 + lax.axis_index(axis_name))
+
+
+class RNGStatesTracker:
+    """Named key registry ≡ CudaRNGStatesTracker (random.py:204-235)."""
+
+    def __init__(self):
+        self.states_ = {}
+
+    def reset(self):
+        self.states_ = {}
+
+    def get_states(self):
+        return dict(self.states_)
+
+    def set_states(self, states):
+        self.states_ = dict(states)
+
+    def add(self, name, seed_or_key):
+        if name in self.states_:
+            raise Exception(f"rng state {name} already exists")
+        if isinstance(seed_or_key, int):
+            seed_or_key = jax.random.PRNGKey(seed_or_key)
+        self.states_[name] = seed_or_key
+
+    def fork(self, name=_MODEL_PARALLEL_RNG):
+        """Split off a fresh key under `name` and return it (functional
+        analogue of the `with tracker.fork():` context)."""
+        if name not in self.states_:
+            raise Exception(f"rng state {name} is not added")
+        self.states_[name], sub = jax.random.split(self.states_[name])
+        return sub
+
+
+_GLOBAL_TRACKER = RNGStatesTracker()
+
+
+def get_rng_tracker() -> RNGStatesTracker:
+    """≡ get_cuda_rng_tracker (random.py:194-201)."""
+    return _GLOBAL_TRACKER
+
+
+def model_parallel_seed(seed: int, tracker: Optional[RNGStatesTracker] = None):
+    """≡ model_parallel_cuda_manual_seed (random.py:248-261): install the
+    default + model-parallel keys into the tracker."""
+    t = tracker or _GLOBAL_TRACKER
+    t.reset()
+    t.add("default", jax.random.PRNGKey(seed))
+    t.add(_MODEL_PARALLEL_RNG, jax.random.PRNGKey(seed + 2718))
+    return t
+
+
+def checkpoint(fn, *args, policy=None, prevent_cse: bool = True, **kw):
+    """Activation recomputation ≡ CheckpointFunction (random.py:237-306).
+    `policy` is a jax.checkpoint_policies member for selective
+    checkpointing (≡ partial/selective recompute, arXiv 2205.05198)."""
+    return jax.checkpoint(fn, policy=policy, prevent_cse=prevent_cse)(*args,
+                                                                      **kw)
+
+
+def split_tensor_into_1d_equal_chunks(x, axis_name: str = TP_AXIS):
+    """Shard a flattened activation over tp for distributed storage
+    ≡ random.py:64-72."""
+    n = lax.axis_size(axis_name)
+    flat = x.reshape(-1)
+    per = flat.shape[0] // n
+    idx = lax.axis_index(axis_name)
+    return lax.dynamic_slice(flat, (idx * per,), (per,))
+
+
+def gather_split_1d_tensor(chunk, axis_name: str = TP_AXIS):
+    """Inverse gather ≡ random.py:75-83."""
+    return lax.all_gather(chunk, axis_name, axis=0, tiled=True)
