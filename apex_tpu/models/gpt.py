@@ -1,0 +1,272 @@
+"""GPT — tensor/sequence-parallel transformer LM, the flagship model.
+
+≡ the reference's standalone Megatron GPT
+(apex/transformer/testing/standalone_transformer_lm.py, 1574 LoC;
+standalone_gpt.py:33-50) re-designed TPU-first:
+
+* layout (S, B, H) so sequence-parallel collectives act on dim 0 (same
+  choice as Megatron, and contiguous for TPU lane tiling);
+* attention QKV via ColumnParallelLinear (heads sharded over tp),
+  causal Pallas softmax (or flash attention, ops/flash_attention.py),
+  output via RowParallelLinear;
+* MLP = ColumnParallel → gelu → RowParallel (4x hidden);
+* vocab-parallel embedding + tied-weight LM head + vocab-parallel
+  cross entropy;
+* runs shard-local inside `shard_map` over the (pp, dp, tp) mesh —
+  partition_specs() gives every param its PartitionSpec.
+
+Dropout uses functional keys (fold_in per layer and per tp rank ≡ the
+CudaRNGStatesTracker contract, tensor_parallel/random.py:204-235).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu.ops.layer_norm import fused_layer_norm
+from apex_tpu.ops.softmax import scaled_upper_triang_masked_softmax
+from apex_tpu.parallel.collectives import (
+    copy_to_tensor_model_parallel_region,
+    gather_from_sequence_parallel_region,
+    reduce_scatter_to_sequence_parallel_region,
+    scatter_to_sequence_parallel_region,
+)
+from apex_tpu.parallel.mesh import TP_AXIS
+from apex_tpu.transformer.tensor_parallel.cross_entropy import (
+    vocab_parallel_cross_entropy,
+)
+from apex_tpu.transformer.tensor_parallel.layers import (
+    ColumnParallelLinear,
+    RowParallelLinear,
+    VocabParallelEmbedding,
+)
+from apex_tpu.transformer.tensor_parallel.random import (
+    model_parallel_fold_in,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class GPTConfig:
+    vocab_size: int = 50304
+    seq_len: int = 1024
+    hidden: int = 1024
+    num_layers: int = 24
+    num_heads: int = 16
+    ffn_mult: int = 4
+    dropout: float = 0.0
+    dtype: Any = jnp.float32
+    sequence_parallel: bool = False
+    use_flash_attention: bool = False
+    remat: bool = False            # activation checkpointing per block
+    axis_name: str = TP_AXIS
+
+    @property
+    def head_dim(self):
+        return self.hidden // self.num_heads
+
+
+# preset sizes ≡ gpt_scaling_test.py sweep points
+GPT2_350M = dict(hidden=1024, num_layers=24, num_heads=16)
+GPT2_1p3B = dict(hidden=2048, num_layers=24, num_heads=32)
+
+
+class GPT:
+    def __init__(self, config: GPTConfig):
+        self.c = config
+        c = config
+        self.embed = VocabParallelEmbedding(
+            c.vocab_size, c.hidden, axis_name=c.axis_name,
+            sequence_parallel=c.sequence_parallel)
+        self.blocks = []
+        for _ in range(c.num_layers):
+            qkv = ColumnParallelLinear(
+                c.hidden, 3 * c.hidden, gather_output=False,
+                sequence_parallel=c.sequence_parallel,
+                axis_name=c.axis_name, init_std=0.02)
+            proj = RowParallelLinear(
+                c.hidden, c.hidden, input_is_parallel=True,
+                sequence_parallel=c.sequence_parallel,
+                axis_name=c.axis_name,
+                init_std=0.02 / jnp.sqrt(2.0 * c.num_layers))
+            fc1 = ColumnParallelLinear(
+                c.hidden, c.ffn_mult * c.hidden, gather_output=False,
+                sequence_parallel=c.sequence_parallel,
+                axis_name=c.axis_name, init_std=0.02)
+            fc2 = RowParallelLinear(
+                c.ffn_mult * c.hidden, c.hidden, input_is_parallel=True,
+                sequence_parallel=c.sequence_parallel,
+                axis_name=c.axis_name,
+                init_std=0.02 / jnp.sqrt(2.0 * c.num_layers))
+            self.blocks.append((qkv, proj, fc1, fc2))
+
+    # ------------------------------ params --------------------------------
+    def init(self, key):
+        c = self.c
+        keys = jax.random.split(key, 2 + 4 * c.num_layers)
+        params = {
+            "embed": self.embed.init(keys[0], c.dtype),
+            "pos_embed": jax.random.normal(
+                keys[1], (c.seq_len, c.hidden), c.dtype) * 0.02,
+            "final_ln": {"weight": jnp.ones((c.hidden,), c.dtype),
+                         "bias": jnp.zeros((c.hidden,), c.dtype)},
+        }
+        for i, (qkv, proj, fc1, fc2) in enumerate(self.blocks):
+            k = keys[2 + 4 * i: 6 + 4 * i]
+            params[f"block{i}"] = {
+                "ln1": {"weight": jnp.ones((c.hidden,), c.dtype),
+                        "bias": jnp.zeros((c.hidden,), c.dtype)},
+                "qkv": qkv.init(k[0], c.dtype),
+                "proj": proj.init(k[1], c.dtype),
+                "ln2": {"weight": jnp.ones((c.hidden,), c.dtype),
+                        "bias": jnp.zeros((c.hidden,), c.dtype)},
+                "fc1": fc1.init(k[2], c.dtype),
+                "fc2": fc2.init(k[3], c.dtype),
+            }
+        return params
+
+    def partition_specs(self):
+        """PartitionSpec pytree matching init() — the TP sharding map
+        (≡ the tensor_model_parallel param attributes, layers.py:70-107)."""
+        c = self.c
+        specs = {
+            "embed": {"weight": P(c.axis_name, None)},
+            "pos_embed": P(),
+            "final_ln": {"weight": P(), "bias": P()},
+        }
+        col = {"weight": P(None, c.axis_name), "bias": P(c.axis_name)}
+        row = {"weight": P(c.axis_name, None), "bias": P()}
+        for i in range(c.num_layers):
+            specs[f"block{i}"] = {
+                "ln1": {"weight": P(), "bias": P()},
+                "qkv": dict(col), "proj": dict(row),
+                "ln2": {"weight": P(), "bias": P()},
+                "fc1": dict(col), "fc2": dict(row),
+            }
+        return specs
+
+    # ------------------------------ forward -------------------------------
+    def _ln(self, p, x):
+        w, b = p["weight"], p["bias"]
+        if self.c.sequence_parallel:
+            w = copy_to_tensor_model_parallel_region(w, self.c.axis_name)
+            b = copy_to_tensor_model_parallel_region(b, self.c.axis_name)
+        return fused_layer_norm(x, w, b)
+
+    def _dropout(self, key, x):
+        if self.c.dropout == 0.0 or key is None:
+            return x
+        keep = 1.0 - self.c.dropout
+        mask = jax.random.bernoulli(key, keep, x.shape)
+        return jnp.where(mask, x / keep, 0.0)
+
+    def _attention(self, block_params, qkv_mod, proj_mod, x, key):
+        """x: (S[, /tp], B, H) local.  Heads sharded over tp."""
+        c = self.c
+        qkv = qkv_mod.apply(block_params["qkv"], x)  # (S, B, 3H/tp)
+        s, b, _ = qkv.shape
+        nh_local = qkv.shape[-1] // (3 * c.head_dim)
+        qkv = qkv.reshape(s, b, 3, nh_local, c.head_dim)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        # (b, nh, s, hd)
+        q = q.transpose(1, 2, 0, 3)
+        k = k.transpose(1, 2, 0, 3)
+        v = v.transpose(1, 2, 0, 3)
+        if c.use_flash_attention:
+            from apex_tpu.ops.flash_attention import flash_attention
+            ctx = flash_attention(q, k, v, causal=True,
+                                  softmax_scale=1.0 / jnp.sqrt(c.head_dim))
+        else:
+            scores = jnp.einsum("bnsh,bnth->bnst", q, k,
+                                preferred_element_type=jnp.float32
+                                ).astype(x.dtype)
+            probs = scaled_upper_triang_masked_softmax(
+                scores.reshape(-1, s, s),
+                1.0 / math.sqrt(c.head_dim)).reshape(scores.shape)
+            probs = self._dropout(key, probs)
+            ctx = jnp.einsum("bnst,bnth->bnsh", probs, v,
+                             preferred_element_type=jnp.float32
+                             ).astype(x.dtype)
+        ctx = ctx.transpose(2, 0, 1, 3).reshape(s, b, -1)  # (S,B,H/tp)
+        return proj_mod.apply(block_params["proj"], ctx)
+
+    def _block(self, i, params, x, key):
+        qkv_mod, proj_mod, fc1, fc2 = self.blocks[i]
+        bp = params
+        k1 = k2 = k3 = None
+        if key is not None:
+            k1, k2, k3 = jax.random.split(key, 3)
+        h = self._ln(bp["ln1"], x)
+        attn = self._attention(bp, qkv_mod, proj_mod, h, k1)
+        x = x + self._dropout(k2, attn)
+        h = self._ln(bp["ln2"], x)
+        m = fc1.apply(bp["fc1"], h)
+        m = jax.nn.gelu(m, approximate=True)
+        m = fc2.apply(bp["fc2"], m)
+        x = x + self._dropout(k3, m)
+        return x
+
+    def apply(self, params, tokens, key=None):
+        """tokens: (B, S) global int ids (replicated over tp).
+        Returns hidden states (S[, /tp], B, H) local and a closure-free
+        path to logits/loss below.  Shard-local: call inside shard_map.
+        """
+        c = self.c
+        ids = tokens.T  # (S, B)
+        h = self.embed.apply(params["embed"], ids)  # (S,B,H) or (S/tp,B,H)
+        pos = params["pos_embed"][: tokens.shape[1]][:, None, :]
+        if c.sequence_parallel:
+            pos = scatter_to_sequence_parallel_region(pos, c.axis_name)
+        h = h + pos.astype(h.dtype)
+        if key is not None:
+            key = model_parallel_fold_in(key, c.axis_name)
+        for i in range(c.num_layers):
+            bk = None if key is None else jax.random.fold_in(key, i)
+            blk = lambda p, x: self._block(i, p, x, bk)
+            if c.remat:
+                blk = jax.checkpoint(blk)
+            h = blk(params[f"block{i}"], h)
+        h = self._ln_final(params, h)
+        return h
+
+    def _ln_final(self, params, h):
+        p = params["final_ln"]
+        w, b = p["weight"], p["bias"]
+        if self.c.sequence_parallel:
+            w = copy_to_tensor_model_parallel_region(w, self.c.axis_name)
+            b = copy_to_tensor_model_parallel_region(b, self.c.axis_name)
+        return fused_layer_norm(h, w, b)
+
+    def logits_local(self, params, h):
+        """LM head with tied embedding weight → vocab-sharded logits
+        (S, B, V/tp).  With SP the hidden is re-gathered first."""
+        c = self.c
+        if c.sequence_parallel:
+            h = gather_from_sequence_parallel_region(h, c.axis_name)
+        w = params["embed"]["weight"]  # local (V/tp, H)
+        x = copy_to_tensor_model_parallel_region(h, c.axis_name)
+        return jnp.einsum("sbh,vh->sbv", x, w,
+                          preferred_element_type=jnp.float32)
+
+    def loss(self, params, tokens, labels, key=None):
+        """Mean LM loss.  tokens/labels: (B, S) global."""
+        h = self.apply(params, tokens, key)
+        logits = self.logits_local(params, h)  # (S,B,V/tp)
+        loss = vocab_parallel_cross_entropy(
+            logits, labels.T, axis_name=self.c.axis_name)
+        return jnp.mean(loss)
+
+
+def gpt_350m(**overrides) -> GPT:
+    cfg = {**GPT2_350M, **overrides}
+    return GPT(GPTConfig(**cfg))
+
+
+def gpt_1p3b(**overrides) -> GPT:
+    cfg = {**GPT2_1p3B, **overrides}
+    return GPT(GPTConfig(**cfg))

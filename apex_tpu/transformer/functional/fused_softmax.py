@@ -1,0 +1,60 @@
+"""Attention-softmax dispatcher.
+
+≡ apex/transformer/functional/fused_softmax.py:166-276
+(FusedScaleMaskSoftmax): picks the fused kernel variant (causal /
+masked / plain) by attention-mask type and shape, with a plain-jnp
+fallback — mirroring is_kernel_available (222-247).  On TPU the "fused
+kernel" is the Pallas softmax family (ops/softmax.py); the CUDA
+seq-length/batch-per-block constraints disappear.
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Optional
+
+import jax.numpy as jnp
+
+from apex_tpu.ops import softmax as S
+
+
+class AttnMaskType(enum.Enum):
+    """≡ apex/transformer/enums.py AttnMaskType."""
+    padding = 1
+    causal = 2
+    no_mask = 3
+
+
+class FusedScaleMaskSoftmax:
+    """≡ FusedScaleMaskSoftmax (fused_softmax.py:166-276)."""
+
+    def __init__(self, attn_mask_type: AttnMaskType = AttnMaskType.padding,
+                 scaled_masked_softmax_fusion: bool = True,
+                 mask_func=None, softmax_in_fp32: bool = True,
+                 scale: Optional[float] = None):
+        self.attn_mask_type = attn_mask_type
+        self.fusion = scaled_masked_softmax_fusion
+        self.mask_func = mask_func
+        self.softmax_in_fp32 = softmax_in_fp32
+        self.scale = scale
+        if self.scale is not None and not softmax_in_fp32:
+            raise RuntimeError(
+                "softmax should be in fp32 when scaled")
+
+    def is_kernel_available(self, mask, b, np_, sq, sk) -> bool:
+        """≡ fused_softmax.py:222-247 — on TPU the blocked Pallas kernel
+        covers every shape; only the fusion flag gates it."""
+        return self.fusion
+
+    def __call__(self, inputs, mask=None, use_pallas_override=None):
+        scale = self.scale if self.scale is not None else 1.0
+        if self.attn_mask_type == AttnMaskType.causal:
+            b, np_, sq, sk = inputs.shape
+            x = inputs.reshape(-1, sq, sk)
+            out = S.scaled_upper_triang_masked_softmax(
+                x, scale, use_pallas_override)
+            return out.reshape(inputs.shape)
+        if mask is not None:
+            return S.scaled_masked_softmax(inputs, mask, scale,
+                                           use_pallas_override)
+        return S.scaled_softmax(inputs, scale, use_pallas_override)

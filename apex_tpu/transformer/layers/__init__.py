@@ -1,0 +1,1 @@
+from apex_tpu.transformer.layers.layer_norm import LayerNorm  # noqa: F401

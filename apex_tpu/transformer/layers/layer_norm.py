@@ -1,0 +1,39 @@
+"""Sequence-parallel-aware LayerNorm.
+
+≡ apex/transformer/layers/layer_norm.py:26-74: a LayerNorm whose params
+carry `sequence_parallel_enabled` so the trainer all-reduces their grads
+over the TP group.  TPU version: instead of tagging + external
+allreduce, the params are routed through the identity-fwd/psum-bwd
+collective when sequence-parallel, making the grad reduction part of
+the autodiff graph.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from apex_tpu.ops.layer_norm import FusedLayerNorm, fused_layer_norm
+from apex_tpu.parallel.collectives import (
+    copy_to_tensor_model_parallel_region)
+from apex_tpu.parallel.mesh import TP_AXIS
+
+
+class LayerNorm(FusedLayerNorm):
+    """≡ apex.transformer.layers.LayerNorm — FusedLayerNorm with the
+    sequence_parallel_enabled contract."""
+
+    def __init__(self, normalized_shape, eps=1e-5, elementwise_affine=True,
+                 sequence_parallel_enabled: bool = False,
+                 axis_name: str = TP_AXIS):
+        super().__init__(normalized_shape, eps, elementwise_affine)
+        self.sequence_parallel_enabled = sequence_parallel_enabled
+        self.axis_name = axis_name
+
+    def apply(self, params, x, use_pallas_override=None):
+        w = params.get("weight") if self.elementwise_affine else None
+        b = params.get("bias") if self.elementwise_affine else None
+        if self.sequence_parallel_enabled and w is not None:
+            w = copy_to_tensor_model_parallel_region(w, self.axis_name)
+            if b is not None:
+                b = copy_to_tensor_model_parallel_region(b, self.axis_name)
+        return fused_layer_norm(x, w, b, self.eps, use_pallas_override)
