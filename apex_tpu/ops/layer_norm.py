@@ -95,9 +95,17 @@ def _bwd_kernel(g_ref, x_ref, mean_ref, rstd_ref, w_ref,
         dx = rstd * (wg - c1 - xhat * c2)
     dx_ref[...] = dx.astype(dx_ref.dtype)
     if affine:
-        # per-row-block partials; reduced over the grid axis outside
-        dw_ref[...] = jnp.sum(g * xhat, axis=0, keepdims=True)
-        db_ref[...] = jnp.sum(g, axis=0, keepdims=True)
+        # accumulate dw/db across the sequential grid (single (1, hidden)
+        # output revisited every step — TPU grids are sequential)
+        i = pl.program_id(0)
+
+        @pl.when(i == 0)
+        def _init():
+            dw_ref[...] = jnp.zeros_like(dw_ref)
+            db_ref[...] = jnp.zeros_like(db_ref)
+
+        dw_ref[...] += jnp.sum(g * xhat, axis=0, keepdims=True)
+        db_ref[...] += jnp.sum(g, axis=0, keepdims=True)
 
 
 def _pad_rows(x2, block):
@@ -167,18 +175,18 @@ def _bwd_pallas(g2, x2, mean, rstd, weight, rms):
         ],
         out_specs=[
             pl.BlockSpec((blk, hidden), lambda i: (i, 0)),
-            pl.BlockSpec((1, hidden), lambda i: (i, 0)),
-            pl.BlockSpec((1, hidden), lambda i: (i, 0)),
+            pl.BlockSpec((1, hidden), lambda i: (0, 0)),
+            pl.BlockSpec((1, hidden), lambda i: (0, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((prows, hidden), x2.dtype),
-            jax.ShapeDtypeStruct((grid, hidden), jnp.float32),
-            jax.ShapeDtypeStruct((grid, hidden), jnp.float32),
+            jax.ShapeDtypeStruct((1, hidden), jnp.float32),
+            jax.ShapeDtypeStruct((1, hidden), jnp.float32),
         ],
         interpret=pallas_interpret(),
     )(g2p, x2p, meanp, rstdp, w)
-    dw = jnp.sum(dwp, axis=0) if affine else None
-    db = jnp.sum(dbp, axis=0) if affine else None
+    dw = dwp[0] if affine else None
+    db = dbp[0] if affine else None
     return dx[:rows], dw, db
 
 

