@@ -180,7 +180,7 @@ class GPT:
         if c.use_flash_attention:
             from apex_tpu.ops.flash_attention import flash_attention
             ctx = flash_attention(q, k, v, causal=True,
-                                  softmax_scale=1.0 / jnp.sqrt(c.head_dim))
+                                  softmax_scale=1.0 / math.sqrt(c.head_dim))
         else:
             scores = jnp.einsum("bnsh,bnth->bnst", q, k,
                                 preferred_element_type=jnp.float32

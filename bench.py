@@ -35,7 +35,8 @@ def main():
         batch, seq = 8, 1024
         cfg = GPTConfig(vocab_size=50304, seq_len=seq, hidden=1024,
                         num_layers=24, num_heads=16, dropout=0.0,
-                        dtype=jnp.bfloat16, remat=True)
+                        dtype=jnp.bfloat16, remat=True,
+                        use_flash_attention=True)
         iters, warmup = 20, 3
     else:  # CPU smoke mode
         batch, seq = 2, 64
@@ -55,14 +56,17 @@ def main():
                                 cfg.vocab_size)
     labels = jnp.roll(tokens, -1, axis=1)
 
+    import numpy as np
+
     for _ in range(warmup):
         opt_state, loss = step(opt_state, tokens, labels)
-    jax.block_until_ready(loss)
+    _ = np.asarray(loss)  # full sync (block_until_ready is unreliable
+    # through the remote-tunnel backend)
 
     t0 = time.perf_counter()
     for _ in range(iters):
         opt_state, loss = step(opt_state, tokens, labels)
-    jax.block_until_ready(loss)
+    _ = np.asarray(loss)
     dt = (time.perf_counter() - t0) / iters
 
     tokens_per_sec = batch * seq / dt
