@@ -1,0 +1,11 @@
+"""apex_tpu.transformer.pipeline_parallel ≡ apex/transformer/pipeline_parallel:
+stage-to-stage communication, schedules, microbatch utilities."""
+
+from apex_tpu.transformer.pipeline_parallel.schedules import (  # noqa: F401
+    forward_backward_no_pipelining,
+    forward_backward_pipelining_without_interleaving,
+    get_forward_backward_func,
+    spmd_pipeline,
+)
+from apex_tpu.transformer.pipeline_parallel import p2p_communication  # noqa: F401
+from apex_tpu.transformer.pipeline_parallel import utils  # noqa: F401

@@ -1,0 +1,48 @@
+"""Stage-to-stage activation transfer.
+
+≡ apex/transformer/pipeline_parallel/p2p_communication.py:48-690: the
+reference wraps batched NCCL isend/irecv pairs with shape negotiation.
+On TPU, stage transfer inside the SPMD pipeline is a single
+`lax.ppermute` over the pp mesh axis riding ICI — shapes are static so
+the negotiation protocol (168-383) disappears; "async" is XLA's default.
+
+The 8 public ops (recv_forward … send_forward_backward_recv_forward_backward,
+p2p_communication.py:385-690) reduce to forward/backward ring shifts:
+a send_forward IS everyone's recv_forward under SPMD.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax import lax
+
+from apex_tpu.parallel.mesh import PP_AXIS
+
+
+def _shift(x, axis_name, delta):
+    n = lax.axis_size(axis_name)
+    perm = [(i, (i + delta) % n) for i in range(n)]
+    return lax.ppermute(x, axis_name, perm)
+
+
+def send_forward_recv_forward(x, axis_name: str = PP_AXIS):
+    """Shift activations one stage forward (stage i → i+1).
+    ≡ send_forward + recv_forward (p2p_communication.py:385-475)."""
+    return _shift(x, axis_name, +1)
+
+
+def send_backward_recv_backward(g, axis_name: str = PP_AXIS):
+    """Shift gradients one stage backward (stage i → i-1).
+    ≡ send_backward + recv_backward (p2p_communication.py:478-568)."""
+    return _shift(g, axis_name, -1)
+
+
+# aliases matching the reference op names; under SPMD each pair is one op
+recv_forward = send_forward = send_forward_recv_forward
+recv_backward = send_backward = send_backward_recv_backward
+
+
+def send_forward_backward_recv_forward_backward(x, g,
+                                                axis_name: str = PP_AXIS):
+    """≡ p2p_communication.py:571-690 (the fused steady-state 1F1B op)."""
+    return _shift(x, axis_name, +1), _shift(g, axis_name, -1)

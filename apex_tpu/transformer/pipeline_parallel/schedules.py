@@ -1,0 +1,210 @@
+"""Pipeline schedules — microbatched fwd+bwd over pp stages.
+
+≡ apex/transformer/pipeline_parallel/schedules/: the reference drives an
+imperative MPMD 1F1B schedule (fwd_bwd_pipelining_without_interleaving.py:241-597)
+with explicit warmup/steady/cooldown phases, p2p sends, and grad-sync
+gating.  The TPU re-design is SPMD: ONE jitted program per train step in
+which every stage (a pp mesh coordinate) runs the same clocked loop —
+microbatch m enters stage 0 at clock m, activations shift stage→stage
+with `ppermute` each clock, and reverse-mode AD of the clocked scan IS
+the backward pipeline (gradient ppermutes run in the transposed
+direction automatically).  Phase boundaries (warmup = first pp-1 clocks,
+cooldown = last pp-1) fall out of the clock arithmetic instead of being
+hand-scheduled; overlap of the backward pipe with forward clocks (the
+point of 1F1B) is XLA's scheduling domain.  Activation-memory control —
+the other point of 1F1B — is `jax.checkpoint` on the stage function
+(pass remat_stage=True), matching the reference's partial-checkpointing
+knob (fwd_bwd_pipelining_without_interleaving.py:351-362).
+
+The interleaved (virtual-pp) schedule maps to num_model_chunks > 1:
+each device holds several non-adjacent layer chunks and the clocked
+loop cycles microbatches through chunk 0 of all stages, then chunk 1,
+… (≡ fwd_bwd_pipelining_with_interleaving.py:27-744).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from apex_tpu.parallel.collectives import (
+    reduce_from_tensor_model_parallel_region as _bcast_from_last)
+from apex_tpu.parallel.mesh import PP_AXIS
+
+
+def spmd_pipeline(stage_fn: Callable, stage_params, microbatches, *,
+                  axis_name: str = PP_AXIS, num_model_chunks: int = 1,
+                  remat_stage: bool = False):
+    """Run `microbatches` through pp × num_model_chunks sequential stages.
+
+    stage_fn(chunk_params, x, chunk_index) -> y — the layers owned by one
+    (stage, chunk); shapes of x and y must match (transformer blocks).
+    stage_params: pytree whose leaves are stacked over chunks on dim 0
+    (leading dim num_model_chunks; pass chunk dim even when 1).
+    microbatches: (m, ...) stacked microbatch inputs (the stage-0 feed).
+
+    Returns (m, ...) outputs "as if" x was passed through all stages in
+    order.  Call inside shard_map; this device holds its pp shard of
+    stage_params.  Differentiable: AD yields the reverse pipeline.
+    """
+    pp = lax.axis_size(axis_name)
+    stage = lax.axis_index(axis_name)
+    m = microbatches.shape[0]
+    total_stages = pp * num_model_chunks
+    clocks = m + total_stages - 1
+
+    def one_stage(params, x, chunk):
+        fn = stage_fn
+        if remat_stage:
+            fn = jax.checkpoint(stage_fn)
+        return fn(params, x, chunk)
+
+    mb_shape = microbatches.shape[1:]
+    dtype = microbatches.dtype
+
+    if num_model_chunks == 1:
+        def clock1(carry, t):
+            x_in, out = carry
+            feed = lax.dynamic_index_in_dim(
+                microbatches, jnp.clip(t, 0, m - 1), axis=0, keepdims=False)
+            x = jnp.where(stage == 0, feed, x_in)
+            params0 = jax.tree_util.tree_map(lambda l: l[0], stage_params)
+            y = one_stage(params0, x, 0)
+            k = t - (pp - 1)  # microbatch index completing at last stage
+            write = jnp.logical_and(stage == pp - 1,
+                                    jnp.logical_and(k >= 0, k < m))
+            out = lax.cond(
+                write,
+                lambda o: lax.dynamic_update_index_in_dim(
+                    o, y, jnp.clip(k, 0, m - 1), axis=0),
+                lambda o: o, out)
+            x_next = _ring_shift(y, axis_name, +1)
+            return (x_next, out), None
+
+        x0 = jnp.zeros(mb_shape, dtype)
+        out0 = jnp.zeros((m,) + mb_shape, dtype)
+        (xf, out), _ = lax.scan(clock1, (x0, out0), jnp.arange(clocks))
+        return _broadcast_from_last(out, stage, pp, axis_name)
+
+    # interleaved: iterate chunks sequentially per clock with a ring
+    # shift after each chunk (chunk boundary stage pp-1 → stage 0)
+    def clockN(carry, t):
+        xs, out = carry  # xs: (chunks,) stacked stage inputs
+        new_xs = []
+        for c in range(num_model_chunks):
+            x = xs[c]
+            if c == 0:
+                feed = lax.dynamic_index_in_dim(
+                    microbatches, jnp.clip(t, 0, m - 1), axis=0,
+                    keepdims=False)
+                x = jnp.where(stage == 0, feed, x)
+            params_c = jax.tree_util.tree_map(lambda l: l[c], stage_params)
+            y = one_stage(params_c, x, c)
+            k = t - c * pp - stage
+            valid = jnp.logical_and(k >= 0, k < m)
+            y = jnp.where(valid, y, x)
+            if c == num_model_chunks - 1:
+                kk = t - (pp * num_model_chunks - 1)
+                write = jnp.logical_and(stage == pp - 1,
+                                        jnp.logical_and(kk >= 0, kk < m))
+                out = lax.cond(
+                    write,
+                    lambda o: lax.dynamic_update_index_in_dim(
+                        o, y, jnp.clip(kk, 0, m - 1), axis=0),
+                    lambda o: o, out)
+            shifted = _ring_shift(y, axis_name, +1)
+            new_xs.append(shifted)
+        # routing for next clock: stage s>0 chunk c reads chunk c's shift
+        # from stage s-1; stage 0 chunk c>0 reads chunk c-1's wrap from
+        # stage pp-1 (the same ring shift); stage 0 chunk 0 is re-fed.
+        nxt = [new_xs[0]]
+        for c in range(1, num_model_chunks):
+            nxt.append(jnp.where(stage == 0, new_xs[c - 1], new_xs[c]))
+        return (jnp.stack(nxt), out), None
+
+    xs0 = jnp.zeros((num_model_chunks,) + mb_shape, dtype)
+    out0 = jnp.zeros((m,) + mb_shape, dtype)
+    (xsf, out), _ = lax.scan(clockN, (xs0, out0),
+                             jnp.arange(m + total_stages - 1))
+    return _broadcast_from_last(out, stage, pp, axis_name)
+
+
+def _broadcast_from_last(out, stage, pp, axis_name):
+    """Replicate the last stage's output buffer to every stage with the
+    psum-fwd/identity-bwd pair: each stage seeds its own loss cotangent
+    in backward, so only the last stage's flows into the pipeline (the
+    others hit the zero mask) — no double counting."""
+    masked = jnp.where(stage == pp - 1, out, jnp.zeros_like(out))
+    return _bcast_from_last(masked, axis_name)
+
+
+def _ring_shift(x, axis_name, delta):
+    n = lax.axis_size(axis_name)
+    perm = [(i, (i + delta) % n) for i in range(n)]
+    return lax.ppermute(x, axis_name, perm)
+
+
+# ------------------------- reference-shaped drivers -------------------------
+
+def forward_backward_no_pipelining(forward_step_func, batch, model_params, *,
+                                   num_microbatches: int,
+                                   grad_fn: Optional[Callable] = None):
+    """≡ fwd_bwd_no_pipelining.py:23-120: loop microbatches, average loss
+    and accumulate grads (no_sync semantics are implicit — grads sync
+    when the caller psums them once after this returns).
+
+    forward_step_func(params, microbatch) -> scalar loss.
+    batch: pytree with leading dim num_microbatches.
+    Returns (mean_loss, grads) via value_and_grad.
+    """
+    def total_loss(p):
+        acc, _ = lax.scan(
+            lambda a, mb: (a + forward_step_func(p, mb), None),
+            jnp.zeros((), jnp.float32), batch)
+        return acc / num_microbatches
+
+    loss, grads = jax.value_and_grad(total_loss)(model_params)
+    return loss, grads
+
+
+def forward_backward_pipelining_without_interleaving(
+        stage_fn, stage_params, microbatches, loss_fn, *,
+        axis_name: str = PP_AXIS, remat_stage: bool = False):
+    """1F1B-equivalent SPMD pipeline ≡
+    fwd_bwd_pipelining_without_interleaving.py:241-597.
+
+    Returns mean loss over microbatches; differentiate the whole thing
+    for the backward pipeline.  loss_fn(y_microbatch) -> scalar.
+    """
+    out = spmd_pipeline(stage_fn, stage_params, microbatches,
+                        axis_name=axis_name, remat_stage=remat_stage)
+    losses = jax.vmap(loss_fn)(out)
+    return jnp.mean(losses)
+
+
+def forward_backward_pipelining_with_interleaving(
+        stage_fn, stage_params, microbatches, loss_fn, *,
+        num_model_chunks: int, axis_name: str = PP_AXIS,
+        remat_stage: bool = False):
+    """Interleaved/virtual-pp schedule ≡
+    fwd_bwd_pipelining_with_interleaving.py:27-744."""
+    out = spmd_pipeline(stage_fn, stage_params, microbatches,
+                        axis_name=axis_name,
+                        num_model_chunks=num_model_chunks,
+                        remat_stage=remat_stage)
+    losses = jax.vmap(loss_fn)(out)
+    return jnp.mean(losses)
+
+
+def get_forward_backward_func(virtual_pipeline_model_parallel_size,
+                              pipeline_model_parallel_size):
+    """≡ schedules/__init__.py:22-38 selector."""
+    if pipeline_model_parallel_size > 1:
+        if virtual_pipeline_model_parallel_size is not None:
+            return forward_backward_pipelining_with_interleaving
+        return forward_backward_pipelining_without_interleaving
+    return forward_backward_no_pipelining

@@ -1,0 +1,109 @@
+"""Pipeline-parallel utilities.
+
+≡ apex/transformer/pipeline_parallel/utils.py: microbatch calculator
+globals (58-140), microbatch slicing (122), loss averaging (242),
+params-L2-norm across model parallel (213), ltor masks (303).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.ops.optimizer_kernels import l2norm_flat
+from apex_tpu.optimizers.flat import flatten
+from apex_tpu.parallel.mesh import DP_AXIS
+from apex_tpu.transformer.microbatches import (
+    build_num_microbatches_calculator,
+)
+
+_GLOBAL_NUM_MICROBATCHES_CALCULATOR = None
+
+
+def setup_microbatch_calculator(rank: int, rampup_batch_size,
+                                global_batch_size: int,
+                                micro_batch_size: int,
+                                data_parallel_size: int):
+    """≡ utils.setup_microbatch_calculator (utils.py:58-76)."""
+    global _GLOBAL_NUM_MICROBATCHES_CALCULATOR
+    _GLOBAL_NUM_MICROBATCHES_CALCULATOR = build_num_microbatches_calculator(
+        rank, rampup_batch_size, global_batch_size, micro_batch_size,
+        data_parallel_size)
+    return _GLOBAL_NUM_MICROBATCHES_CALCULATOR
+
+
+def get_num_microbatches():
+    """≡ utils.get_num_microbatches (utils.py:92)."""
+    return _GLOBAL_NUM_MICROBATCHES_CALCULATOR.get()
+
+
+def get_current_global_batch_size():
+    return _GLOBAL_NUM_MICROBATCHES_CALCULATOR.get_current_global_batch_size()
+
+
+def update_num_microbatches(consumed_samples, consistency_check=True):
+    _GLOBAL_NUM_MICROBATCHES_CALCULATOR.update(consumed_samples,
+                                               consistency_check)
+
+
+def get_kth_microbatch(batch, k: int, micro_batch_size: int):
+    """≡ utils.get_kth_microbatch (utils.py:122-131)."""
+    if batch is None:
+        return None
+    start = k * micro_batch_size
+    return jax.tree_util.tree_map(
+        lambda x: x[start:start + micro_batch_size], batch)
+
+
+def split_into_microbatches(batch, num_microbatches: int):
+    """Reshape a global batch (B, ...) to (m, B/m, ...) for the pipeline."""
+    return jax.tree_util.tree_map(
+        lambda x: x.reshape((num_microbatches, x.shape[0] // num_microbatches)
+                            + x.shape[1:]), batch)
+
+
+def average_losses_across_data_parallel_group(losses,
+                                              axis_name: str = DP_AXIS):
+    """≡ utils.average_losses_across_data_parallel_group (utils.py:242-250).
+    Call inside the SPMD region."""
+    stacked = jnp.stack([jnp.asarray(l, jnp.float32) for l in losses])
+    return jax.lax.pmean(stacked, axis_name)
+
+
+def calc_params_l2_norm(params):
+    """≡ utils.calc_params_l2_norm (utils.py:213-239) — fused flat-buffer
+    norm; for model-parallel params psum the squared local norm over tp
+    before sqrt at the call site."""
+    return l2norm_flat(flatten(params, jnp.float32))
+
+
+def get_ltor_masks_and_position_ids(tokens, eod_token: Optional[int] = None,
+                                    reset_position_ids: bool = False,
+                                    reset_attention_mask: bool = False,
+                                    eod_mask_loss: bool = False):
+    """≡ utils.get_ltor_masks_and_position_ids (utils.py:303-330),
+    simplified to the non-reset fast path (reset variants are documented
+    gaps: they need per-document mask rebuilds that are host-side in the
+    reference too)."""
+    b, s = tokens.shape
+    causal = jnp.tril(jnp.ones((s, s), bool))
+    attention_mask = jnp.broadcast_to(causal, (b, 1, s, s))
+    loss_mask = jnp.ones((b, s), jnp.float32)
+    if eod_mask_loss and eod_token is not None:
+        loss_mask = jnp.where(tokens == eod_token, 0.0, loss_mask)
+    position_ids = jnp.broadcast_to(jnp.arange(s), (b, s))
+    return attention_mask, loss_mask, position_ids
+
+
+def report_memory(name=""):
+    """≡ utils.report_memory (utils.py:253-263) — XLA/TPU version."""
+    stats = []
+    for d in jax.local_devices():
+        try:
+            m = d.memory_stats()
+            stats.append(f"{d}: {m.get('bytes_in_use', 0) / 1e9:.2f}GB in use")
+        except Exception:
+            stats.append(f"{d}: memory stats unavailable")
+    return f"[{name}] " + "; ".join(stats)

@@ -1,0 +1,172 @@
+"""Pipeline schedule tests ≡ tests/L0/run_transformer/
+test_pipeline_parallel_fwd_bwd.py and test_microbatches.py: the SPMD
+pipeline produces the same outputs/grads as sequential layer
+application, for both plain and interleaved schedules."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu.parallel import mesh as M
+from apex_tpu.transformer.microbatches import (
+    ConstantNumMicroBatches,
+    RampupBatchsizeNumMicroBatches,
+)
+from apex_tpu.transformer.pipeline_parallel.schedules import (
+    forward_backward_no_pipelining,
+    spmd_pipeline,
+)
+from apex_tpu.transformer.pipeline_parallel.utils import (
+    split_into_microbatches,
+)
+
+PP = 4
+D = 8
+
+
+def _mesh(pp=PP):
+    M.destroy_model_parallel()
+    return M.initialize_model_parallel(pipeline_model_parallel_size=pp)
+
+
+def _stage_fn(params, x, chunk):
+    # one "layer": x @ w + tanh residual — shape-preserving
+    return x + jnp.tanh(x @ params["w"] + params["b"])
+
+
+def _make_params(key, n_layers):
+    ks = jax.random.split(key, n_layers)
+    return {
+        "w": jnp.stack([jax.random.normal(k, (D, D)) * 0.3 for k in ks]),
+        "b": jnp.zeros((n_layers, D)),
+    }
+
+
+def _sequential(params, x, n_layers):
+    for i in range(n_layers):
+        x = _stage_fn({"w": params["w"][i], "b": params["b"][i]}, x, 0)
+    return x
+
+
+@pytest.mark.parametrize("m", [4, 8])
+def test_pipeline_matches_sequential(m):
+    """pp=4, one layer per stage: pipeline out == sequential out."""
+    mesh = _mesh()
+    params = _make_params(jax.random.PRNGKey(0), PP)
+    mbs = jax.random.normal(jax.random.PRNGKey(1), (m, 2, D))
+
+    # stage s holds layer s: the sharded leading dim (local size 1) IS
+    # the chunk dim for num_model_chunks=1
+    def local(params, mbs):
+        return spmd_pipeline(_stage_fn, params, mbs, num_model_chunks=1)
+
+    f = shard_map(local, mesh=mesh,
+                  in_specs=({"w": P("pp"), "b": P("pp")}, P()),
+                  out_specs=P(), check_vma=False)
+    got = f(params, mbs)
+    want = jax.vmap(lambda x: _sequential(params, x, PP))(mbs)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_pipeline_grads_match_sequential():
+    mesh = _mesh()
+    params = _make_params(jax.random.PRNGKey(2), PP)
+    mbs = jax.random.normal(jax.random.PRNGKey(3), (4, 2, D))
+
+    def local_grad(params, mbs):
+        def loss(p):
+            out = spmd_pipeline(_stage_fn, p, mbs, num_model_chunks=1)
+            return jnp.mean(out ** 2)
+        return jax.grad(loss)(params)
+
+    g = shard_map(local_grad, mesh=mesh,
+                  in_specs=({"w": P("pp"), "b": P("pp")}, P()),
+                  out_specs={"w": P("pp"), "b": P("pp")},
+                  check_vma=False)(params, mbs)
+
+    def ref_loss(p):
+        out = jax.vmap(lambda x: _sequential(p, x, PP))(mbs)
+        return jnp.mean(out ** 2)
+
+    r = jax.grad(ref_loss)(params)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5), g, r)
+
+
+def test_interleaved_pipeline_matches_sequential():
+    """pp=4 × 2 chunks = 8 global stages ≡ interleaved schedule."""
+    mesh = _mesh()
+    n_layers = PP * 2
+    params = _make_params(jax.random.PRNGKey(4), n_layers)
+    mbs = jax.random.normal(jax.random.PRNGKey(5), (4, 2, D))
+
+    # device s holds layers s (chunk 0) and pp+s (chunk 1): stacked
+    # leaves (pp, chunks, ...) — reshape global (2*pp, ...) accordingly
+    def reorder(l):
+        # global layer index g = c*pp + s → device s, chunk c
+        return l.reshape(2, PP, *l.shape[1:]).swapaxes(0, 1)
+
+    dev_params = jax.tree_util.tree_map(reorder, params)
+
+    def local(params, mbs):
+        # local leaf (1, chunks, ...): drop the sharded stage dim
+        p = jax.tree_util.tree_map(lambda l: l[0], params)
+        return spmd_pipeline(_stage_fn, p, mbs, num_model_chunks=2)
+
+    f = shard_map(local, mesh=mesh,
+                  in_specs=({"w": P("pp"), "b": P("pp")}, P()),
+                  out_specs=P(), check_vma=False)
+    got = f(dev_params, mbs)
+    want = jax.vmap(lambda x: _sequential(params, x, n_layers))(mbs)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_no_pipelining_schedule():
+    params = {"w": jax.random.normal(jax.random.PRNGKey(6), (D, 1)) * 0.1}
+    batch = jax.random.normal(jax.random.PRNGKey(7), (6, 2, D))
+
+    def fwd(p, mb):
+        return jnp.mean((mb @ p["w"]) ** 2)
+
+    loss, grads = forward_backward_no_pipelining(
+        fwd, batch, params, num_microbatches=6)
+    want_loss = jnp.mean(jnp.stack([fwd(params, batch[i])
+                                    for i in range(6)]))
+    np.testing.assert_allclose(float(loss), float(want_loss), rtol=1e-6)
+    r = jax.grad(lambda p: jnp.mean(jnp.stack(
+        [fwd(p, batch[i]) for i in range(6)])))(params)
+    np.testing.assert_allclose(np.asarray(grads["w"]), np.asarray(r["w"]),
+                               rtol=1e-5)
+
+
+def test_microbatch_calculators():
+    """≡ test_microbatches.py + test_dynamic_batchsize.py."""
+    c = ConstantNumMicroBatches(64, 4, 2)
+    assert c.get() == 8
+    assert c.get_current_global_batch_size() == 64
+
+    r = RampupBatchsizeNumMicroBatches(
+        start_batch_size=16, batch_size_increment=16, ramup_samples=48,
+        global_batch_size=64, micro_batch_size=4, data_parallel_size=2)
+    assert r.get_current_global_batch_size() == 16
+    r.update(16, True)
+    assert r.get_current_global_batch_size() == 32
+    r.update(48, True)
+    assert r.get_current_global_batch_size() == 64
+    assert r.get() == 8
+    with pytest.raises(AssertionError):
+        ConstantNumMicroBatches(63, 4, 2)
+
+
+def test_split_into_microbatches():
+    batch = {"x": jnp.arange(24.0).reshape(12, 2)}
+    mbs = split_into_microbatches(batch, 4)
+    assert mbs["x"].shape == (4, 3, 2)
+    np.testing.assert_allclose(np.asarray(mbs["x"][1][0]),
+                               np.asarray(batch["x"][3]))
