@@ -262,6 +262,120 @@ class GPT:
         return jnp.mean(loss)
 
 
+class GPTPipelined(GPT):
+    """GPT over a (pp, dp, tp) mesh: blocks stacked per layer and
+    sharded over pp; embedding / LM head replicated across stages (the
+    reference places them on first/last stage with an embedding group
+    allreduce, parallel_state.py:319-407 — here the tie is exact because
+    every stage holds the same embed weight and grads mix via the
+    pipeline's AD).  Microbatched via the SPMD clocked pipeline
+    (pipeline_parallel/schedules.spmd_pipeline).
+    """
+
+    def __init__(self, config: GPTConfig, num_microbatches: int,
+                 pipeline_parallel_size: int,
+                 num_model_chunks: int = 1, remat_stage: bool = False):
+        super().__init__(config)
+        c = config
+        self.num_microbatches = num_microbatches
+        self.pp = pipeline_parallel_size
+        self.chunks = num_model_chunks
+        self.remat_stage = remat_stage
+        assert c.num_layers % (self.pp * self.chunks) == 0, (
+            "num_layers must divide pp * num_model_chunks")
+        self.layers_per_stage = c.num_layers // (self.pp * self.chunks)
+
+    def init(self, key):
+        flat_params = super().init(key)
+        c = self.c
+        # stack per-layer block params: leaves (L, ...)
+        blocks = [flat_params.pop(f"block{i}") for i in range(c.num_layers)]
+        stacked = jax.tree_util.tree_map(
+            lambda *ls: jnp.stack(ls), *blocks)
+        # reorder (L, ...) → (pp, chunks, layers_per_stage, ...):
+        # global layer g = ((c_idx*pp + s) * lps + j)
+        def reorder(l):
+            return l.reshape(self.chunks, self.pp, self.layers_per_stage,
+                             *l.shape[1:]).swapaxes(0, 1)
+        flat_params["blocks"] = jax.tree_util.tree_map(reorder, stacked)
+        return flat_params
+
+    def partition_specs(self):
+        base = super().partition_specs()
+        c = self.c
+        block_spec = base.pop("block0")
+        for i in range(1, c.num_layers):
+            base.pop(f"block{i}")
+        # blocks leaves gained (pp, chunks, lps) leading dims; pp sharded
+        def add_dims(spec):
+            return P("pp", None, None, *spec)
+        base["blocks"] = jax.tree_util.tree_map(
+            add_dims, block_spec,
+            is_leaf=lambda s: isinstance(s, P))
+        return base
+
+    def _stage_fn(self, stage_blocks, h, chunk):
+        """Apply this stage's layers_per_stage blocks (scanned)."""
+        def body(x, layer_params):
+            return self._block_shared(layer_params, x, None), None
+        h, _ = jax.lax.scan(body, h, stage_blocks)
+        return h
+
+    def _block_shared(self, bp, x, key):
+        """_block with the (shared-config) layer modules of block 0."""
+        qkv_mod, proj_mod, fc1, fc2 = self.blocks[0]
+        h = self._ln(bp["ln1"], x)
+        attn = self._attention(bp, qkv_mod, proj_mod, h, key)
+        x = x + attn
+        h = self._ln(bp["ln2"], x)
+        m = fc1.apply(bp["fc1"], h)
+        m = jax.nn.gelu(m, approximate=True)
+        m = fc2.apply(bp["fc2"], m)
+        return x + m
+
+    def loss(self, params, tokens, labels, key=None):
+        """tokens/labels: (B, S); B = num_microbatches × microbatch size.
+        Shard-local (call inside shard_map over the full mesh)."""
+        from apex_tpu.transformer.pipeline_parallel.schedules import (
+            spmd_pipeline)
+        c = self.c
+        m = self.num_microbatches
+        B, S = tokens.shape
+        assert B % m == 0
+        mb = B // m
+        ids = tokens.reshape(m, mb, S).transpose(0, 2, 1)  # (m, S, mb)
+
+        def embed_one(ids_mb):
+            h = self.embed.apply(params["embed"], ids_mb)
+            pos = params["pos_embed"][:S][:, None, :]
+            if c.sequence_parallel:
+                pos = scatter_to_sequence_parallel_region(pos, c.axis_name)
+            return h + pos.astype(h.dtype)
+
+        h_mbs = jax.vmap(embed_one)(ids)  # (m, S[, /tp], mb, H)
+
+        # local stage params: drop the sharded pp dim (local size 1)
+        stage_blocks = jax.tree_util.tree_map(lambda l: l[0],
+                                              params["blocks"])
+
+        def stage_fn(chunk_blocks, x, chunk):
+            return self._stage_fn(chunk_blocks, x, chunk)
+
+        out = spmd_pipeline(stage_fn, stage_blocks, h_mbs,
+                            num_model_chunks=self.chunks,
+                            remat_stage=self.remat_stage)
+
+        def head_one(h_mb, labels_mb):
+            h_f = self._ln_final(params, h_mb)
+            logits = self.logits_local(params, h_f)  # (S, mb, V/tp)
+            return jnp.mean(vocab_parallel_cross_entropy(
+                logits, labels_mb, axis_name=c.axis_name))
+
+        lbl = labels.reshape(m, mb, S).transpose(0, 2, 1)  # (m, S, mb)
+        losses = jax.vmap(head_one)(out, lbl)
+        return jnp.mean(losses)
+
+
 def gpt_350m(**overrides) -> GPT:
     cfg = {**GPT2_350M, **overrides}
     return GPT(GPTConfig(**cfg))

@@ -39,7 +39,7 @@ def init_sharded_optimizer(optimizer, model, params, mesh):
         return optimizer.init(p)
 
     # buffers sharded over tp (dim 0), step replicated
-    out_specs = type(state_struct)(*([P()] + [P("tp")] * (len(state_struct) - 1)))
+    out_specs = type(state_struct)(*([P()] + [P(("pp", "tp"))] * (len(state_struct) - 1)))
     init_fn = jax.jit(shard_map(local_init, mesh=mesh, in_specs=(specs,),
                                 out_specs=out_specs, check_vma=False))
     return init_fn(params)
@@ -68,7 +68,7 @@ def make_tp_dp_train_step(model, optimizer, mesh, *,
     state_spec_leaves = None
 
     def _state_specs(state):
-        return type(state)(*([P()] + [P("tp")] * (len(state) - 1)))
+        return type(state)(*([P()] + [P(("pp", "tp"))] * (len(state) - 1)))
 
     def build(opt_state):
         out_specs = (_state_specs(opt_state), P())
