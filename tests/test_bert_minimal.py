@@ -1,0 +1,97 @@
+"""BERT minimal ≡ tests/L0/run_transformer/test_bert_minimal.py: TP loss
+consistency, pad-mask behavior, and MLM+NSP convergence with FusedLAMB
+(the BERT+LAMB baseline config, BASELINE.md)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu.models.bert import Bert, BertConfig
+from apex_tpu.optimizers.fused_lamb import FusedLAMB
+from apex_tpu.parallel import mesh as M
+
+VOCAB, SEQ, HID, LAYERS, HEADS = 64, 16, 32, 2, 4
+
+
+def _cfg():
+    return BertConfig(vocab_size=VOCAB, seq_len=SEQ, hidden=HID,
+                      num_layers=LAYERS, num_heads=HEADS)
+
+
+def _data(batch=4):
+    k = jax.random.PRNGKey(0)
+    tokens = jax.random.randint(k, (batch, SEQ), 0, VOCAB)
+    mlm_labels = jax.random.randint(jax.random.PRNGKey(1), (batch, SEQ), 0,
+                                    VOCAB)
+    loss_mask = jax.random.bernoulli(jax.random.PRNGKey(2), 0.15,
+                                     (batch, SEQ))
+    nsp = jax.random.randint(jax.random.PRNGKey(3), (batch,), 0, 2)
+    return tokens, mlm_labels, loss_mask, nsp
+
+
+def _loss(tp):
+    M.destroy_model_parallel()
+    mesh = M.initialize_model_parallel(tensor_model_parallel_size=tp)
+    model = Bert(_cfg())
+    params = model.init(jax.random.PRNGKey(4))
+    tokens, mlm, mask, nsp = _data()
+    f = shard_map(
+        lambda p, t, l, lm, n: model.loss(p, t, l, lm, n),
+        mesh=mesh, in_specs=(model.partition_specs(), P(), P(), P(), P()),
+        out_specs=P(), check_vma=False)
+    out = float(f(params, tokens, mlm, mask, nsp))
+    M.destroy_model_parallel()
+    return out
+
+
+def test_bert_loss_consistent_across_tp():
+    l2 = _loss(2)
+    l4 = _loss(4)
+    np.testing.assert_allclose(l2, l4, rtol=2e-3)
+    # MLM ≈ log(V), NSP ≈ log(2)
+    assert abs(l2 - (np.log(VOCAB) + np.log(2))) < 1.0
+
+
+def test_bert_pad_mask():
+    mesh = M.initialize_model_parallel(tensor_model_parallel_size=2)
+    model = Bert(_cfg())
+    params = model.init(jax.random.PRNGKey(5))
+    tokens, _, _, _ = _data(2)
+    pad = jnp.zeros((2, SEQ), bool).at[:, SEQ // 2:].set(True)
+
+    def enc(p, t, pm):
+        return model.encode(p, t, pad_mask=pm)
+
+    f = shard_map(enc, mesh=mesh,
+                  in_specs=(model.partition_specs(), P(), P()),
+                  out_specs=P(), check_vma=False)
+    h = f(params, tokens, pad)
+    # changing padded tokens must not change unpadded positions' output
+    tokens2 = tokens.at[:, SEQ // 2:].set(0)
+    h2 = f(params, tokens2, pad)
+    np.testing.assert_allclose(np.asarray(h[: SEQ // 2]),
+                               np.asarray(h2[: SEQ // 2]),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_bert_trains_with_lamb():
+    mesh = M.initialize_model_parallel(tensor_model_parallel_size=2)
+    model = Bert(_cfg())
+    params = model.init(jax.random.PRNGKey(6))
+    tokens, mlm, mask, nsp = _data(8)
+    opt = FusedLAMB(lr=2e-2, weight_decay=0.01, use_pallas=False)
+
+    from apex_tpu.transformer.training import (
+        init_sharded_optimizer, make_tp_dp_train_step)
+    opt_state = init_sharded_optimizer(opt, model, params, mesh)
+    step = make_tp_dp_train_step(
+        model, opt, mesh, donate=False,
+        loss_fn=lambda p, t, l: model.loss(p, t, l[0], l[1], l[2]))
+    losses = []
+    for _ in range(12):
+        opt_state, loss = step(opt_state, tokens, (mlm, mask, nsp))
+        losses.append(float(loss))
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0] * 0.9
