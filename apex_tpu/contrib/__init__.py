@@ -1,0 +1,17 @@
+"""apex_tpu.contrib ≡ apex.contrib: optional fused components.
+
+On TPU these are thin compositions over the core Pallas kernels —
+the reference's per-feature CUDA extensions (apex/contrib/csrc/*)
+collapse into flash_attention / welford / collectives / XLA fusions.
+"""
+
+
+def __getattr__(name):
+    import importlib
+    mods = ("multihead_attn", "focal_loss", "index_mul_2d", "transducer",
+            "sparsity", "groupbn", "peer_memory", "bottleneck", "xentropy",
+            "clip_grad", "conv_bias_relu", "fmha", "layer_norm",
+            "optimizers", "cudnn_gbn")
+    if name in mods:
+        return importlib.import_module(f"apex_tpu.contrib.{name}")
+    raise AttributeError(name)

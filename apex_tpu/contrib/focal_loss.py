@@ -1,0 +1,41 @@
+"""Fused focal loss for detection.
+
+≡ apex.contrib.focal_loss (apex/contrib/focal_loss/focal_loss.py:42,
+kernel apex/contrib/csrc/focal_loss/focal_loss_cuda.cu): sigmoid focal
+loss over anchor classification logits with label smoothing.  On TPU
+the whole expression is one XLA fusion (elementwise + reduce) — a
+custom kernel adds nothing over the compiler here; numerics match the
+reference formula.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def focal_loss(cls_output, cls_targets_at_level, num_positives_sum,
+               num_real_classes, alpha=0.25, gamma=2.0,
+               label_smoothing=0.0):
+    """≡ focal_loss_cuda.focal_loss_forward.
+
+    cls_output: (..., num_classes_padded) raw logits.
+    cls_targets_at_level: (...) int; -2 = ignore, -1 = background,
+    >=0 = class id (reference semantics).
+    Returns scalar loss normalized by num_positives_sum.
+    """
+    x = cls_output[..., :num_real_classes].astype(jnp.float32)
+    t = cls_targets_at_level
+    onehot = jax.nn.one_hot(jnp.maximum(t, 0), num_real_classes)
+    y = jnp.where((t >= 0)[..., None], onehot, 0.0)  # background → zeros
+    if label_smoothing > 0:
+        y = y * (1.0 - label_smoothing) + 0.5 * label_smoothing
+    p = jax.nn.sigmoid(x)
+    ce = jnp.maximum(x, 0) - x * y + jnp.log1p(jnp.exp(-jnp.abs(x)))
+    p_t = p * y + (1.0 - p) * (1.0 - y)
+    mod = jnp.power(1.0 - p_t, gamma)
+    alpha_t = alpha * y + (1.0 - alpha) * (1.0 - y)
+    loss = alpha_t * mod * ce
+    valid = (t != -2)[..., None]  # ignore entries contribute nothing
+    loss = jnp.where(valid, loss, 0.0)
+    return jnp.sum(loss) / jnp.maximum(num_positives_sum, 1.0)

@@ -1,0 +1,33 @@
+"""Fused gather-multiply: out = in1[idx] * in2.
+
+≡ apex.contrib.index_mul_2d (apex/contrib/index_mul_2d/index_mul_2d.py:5,
+kernel apex/contrib/csrc/index_mul_2d/index_mul_2d_cuda.cu): fwd/bwd of
+a gather followed by an elementwise multiply.  XLA fuses the gather into
+the multiply on TPU; the custom_vjp mirrors the reference's hand-written
+backward (scatter-add for d_in1, gather-multiply for d_in2).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.custom_vjp
+def index_mul_2d(in1, in2, idx):
+    """in1: (N, D); in2: (M, D); idx: (M,) int → (M, D)."""
+    return jnp.take(in1, idx, axis=0) * in2
+
+
+def _fwd(in1, in2, idx):
+    return index_mul_2d(in1, in2, idx), (in1, in2, idx)
+
+
+def _bwd(res, g):
+    in1, in2, idx = res
+    d_in2 = jnp.take(in1, idx, axis=0) * g
+    d_in1 = jnp.zeros_like(in1).at[idx].add(in2 * g)
+    return d_in1, d_in2, None
+
+
+index_mul_2d.defvjp(_fwd, _bwd)

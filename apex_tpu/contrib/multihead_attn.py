@@ -1,0 +1,162 @@
+"""Fused multihead attention modules — self and encdec variants.
+
+≡ apex.contrib.multihead_attn (apex/contrib/multihead_attn/):
+SelfMultiheadAttn (self_multihead_attn.py:21), EncdecMultiheadAttn, and
+their six fused autograd variants (fast_*_func.py) built on 7.9k LoC of
+cutlass/CUDA (csrc/multihead_attn/*).  TPU re-design: ONE parametrized
+module over the blockwise flash-attention kernel; the variant matrix —
+{self, encdec} × {bias} × {include-norm-add} × {mask} — becomes plain
+composition (pre-LayerNorm + residual add, bias flags), since XLA fuses
+the epilogues the CUDA code hand-wrote.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.ops.flash_attention import attention_reference, flash_attention
+from apex_tpu.ops.layer_norm import fused_layer_norm
+
+
+class SelfMultiheadAttn:
+    """≡ SelfMultiheadAttn (self_multihead_attn.py:21-207).
+
+    impl='fast' ≡ the fused CUDA path → flash attention;
+    impl='default' → reference math.  include_norm_add prepends a
+    LayerNorm and returns output + residual (≡ *_norm_add variants).
+    Layout (S, B, H) like the reference (seq-first).
+    """
+
+    def __init__(self, embed_dim: int, num_heads: int, dropout: float = 0.0,
+                 bias: bool = False, include_norm_add: bool = False,
+                 impl: str = "fast", separate_qkv_params: bool = False):
+        assert embed_dim % num_heads == 0
+        self.embed_dim = embed_dim
+        self.num_heads = num_heads
+        self.head_dim = embed_dim // num_heads
+        self.dropout = dropout
+        self.use_bias = bias
+        self.include_norm_add = include_norm_add
+        self.impl = impl
+        self.separate_qkv_params = separate_qkv_params
+        self.scaling = self.head_dim ** -0.5
+
+    def init(self, key, dtype=jnp.float32):
+        k1, k2 = jax.random.split(key)
+        std = 1.0 / math.sqrt(self.embed_dim)
+        p = {
+            "qkv_weight": jax.random.uniform(
+                k1, (self.embed_dim, 3 * self.embed_dim), dtype, -std, std),
+            "out_weight": jax.random.uniform(
+                k2, (self.embed_dim, self.embed_dim), dtype, -std, std),
+        }
+        if self.use_bias:
+            p["qkv_bias"] = jnp.zeros((3 * self.embed_dim,), dtype)
+            p["out_bias"] = jnp.zeros((self.embed_dim,), dtype)
+        if self.include_norm_add:
+            p["ln"] = {"weight": jnp.ones((self.embed_dim,), dtype),
+                       "bias": jnp.zeros((self.embed_dim,), dtype)}
+        return p
+
+    def apply(self, params, query, key=None, value=None, *,
+              mask=None, is_training: bool = True,
+              dropout_key=None, use_pallas_override=None):
+        x = query
+        residual = x
+        if self.include_norm_add:
+            x = fused_layer_norm(x, params["ln"]["weight"],
+                                 params["ln"]["bias"])
+        s, b, _ = x.shape
+        qkv = x @ params["qkv_weight"].astype(x.dtype)
+        if self.use_bias:
+            qkv = qkv + params["qkv_bias"].astype(x.dtype)
+        qkv = qkv.reshape(s, b, 3, self.num_heads, self.head_dim)
+        q, k, v = (qkv[:, :, i].transpose(1, 2, 0, 3) for i in range(3))
+        ctx = self._core(q, k, v, mask, is_training, dropout_key,
+                         use_pallas_override)
+        ctx = ctx.transpose(2, 0, 1, 3).reshape(s, b, self.embed_dim)
+        out = ctx @ params["out_weight"].astype(x.dtype)
+        if self.use_bias:
+            out = out + params["out_bias"].astype(x.dtype)
+        if self.include_norm_add:
+            out = out + residual
+        return out
+
+    def _core(self, q, k, v, mask, is_training, dropout_key,
+              use_pallas_override):
+        if mask is None and self.dropout == 0.0:
+            return flash_attention(q, k, v, causal=False,
+                                   softmax_scale=self.scaling,
+                                   use_pallas_override=use_pallas_override)
+        # masked / dropout path: reference math (≡ MaskSoftmaxDropout,
+        # mask_softmax_dropout_func.py)
+        s = jnp.einsum("bnqd,bnkd->bnqk", q.astype(jnp.float32),
+                       k.astype(jnp.float32)) * self.scaling
+        if mask is not None:
+            s = jnp.where(mask, -10000.0, s)
+        p = jax.nn.softmax(s, axis=-1)
+        if is_training and self.dropout > 0 and dropout_key is not None:
+            keep = 1.0 - self.dropout
+            dm = jax.random.bernoulli(dropout_key, keep, p.shape)
+            p = jnp.where(dm, p / keep, 0.0)
+        return jnp.einsum("bnqk,bnkd->bnqd", p,
+                          v.astype(jnp.float32)).astype(q.dtype)
+
+
+class EncdecMultiheadAttn(SelfMultiheadAttn):
+    """≡ EncdecMultiheadAttn (encdec_multihead_attn.py): query from the
+    decoder, key/value from the encoder — separate projections."""
+
+    def init(self, key, dtype=jnp.float32):
+        k1, k2, k3 = jax.random.split(key, 3)
+        std = 1.0 / math.sqrt(self.embed_dim)
+        p = {
+            "q_weight": jax.random.uniform(
+                k1, (self.embed_dim, self.embed_dim), dtype, -std, std),
+            "kv_weight": jax.random.uniform(
+                k2, (self.embed_dim, 2 * self.embed_dim), dtype, -std, std),
+            "out_weight": jax.random.uniform(
+                k3, (self.embed_dim, self.embed_dim), dtype, -std, std),
+        }
+        if self.use_bias:
+            p["q_bias"] = jnp.zeros((self.embed_dim,), dtype)
+            p["kv_bias"] = jnp.zeros((2 * self.embed_dim,), dtype)
+            p["out_bias"] = jnp.zeros((self.embed_dim,), dtype)
+        if self.include_norm_add:
+            p["ln"] = {"weight": jnp.ones((self.embed_dim,), dtype),
+                       "bias": jnp.zeros((self.embed_dim,), dtype)}
+        return p
+
+    def apply(self, params, query, key=None, value=None, *, mask=None,
+              is_training: bool = True, dropout_key=None,
+              use_pallas_override=None):
+        enc = key if key is not None else query
+        x = query
+        residual = x
+        if self.include_norm_add:
+            x = fused_layer_norm(x, params["ln"]["weight"],
+                                 params["ln"]["bias"])
+        sq, b, _ = x.shape
+        sk = enc.shape[0]
+        q = x @ params["q_weight"].astype(x.dtype)
+        kv = enc @ params["kv_weight"].astype(enc.dtype)
+        if self.use_bias:
+            q = q + params["q_bias"].astype(x.dtype)
+            kv = kv + params["kv_bias"].astype(x.dtype)
+        q = q.reshape(sq, b, self.num_heads, self.head_dim
+                      ).transpose(1, 2, 0, 3)
+        kv = kv.reshape(sk, b, 2, self.num_heads, self.head_dim)
+        k_, v_ = (kv[:, :, i].transpose(1, 2, 0, 3) for i in range(2))
+        ctx = self._core(q, k_, v_, mask, is_training, dropout_key,
+                         use_pallas_override)
+        ctx = ctx.transpose(2, 0, 1, 3).reshape(sq, b, self.embed_dim)
+        out = ctx @ params["out_weight"].astype(x.dtype)
+        if self.use_bias:
+            out = out + params["out_bias"].astype(x.dtype)
+        if self.include_norm_add:
+            out = out + residual
+        return out

@@ -1,0 +1,125 @@
+"""RNN-T transducer joint + loss.
+
+≡ apex.contrib.transducer (apex/contrib/transducer/transducer.py:5,68;
+kernels apex/contrib/csrc/transducer/transducer_joint_kernel.cu and
+transducer_loss_kernel.cu): the fused broadcast-add joint and the
+alpha/beta forward-backward RNN-T loss.
+
+TPU re-design: the joint is an XLA-fused broadcast add (+ReLU/dropout);
+the loss's alpha DP — sequential in both T and U on CUDA — becomes a
+`lax.scan` over T with a `lax.associative_scan` along U per row: the
+within-row recurrence  x[u] = logaddexp(a[u], x[u-1] + b[u])  is a
+composition of affine log-space maps (a, b), which compose
+associatively, so each row is O(log U) depth on the VPU.  Gradients come
+from AD through the scans (≡ the hand-written backward kernel).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+_NEG = -1e30
+
+
+class TransducerJoint:
+    """≡ TransducerJoint (transducer.py:5-66): h = f[:, :, None] +
+    g[:, None, :] with optional relu/dropout (packing omitted — XLA has
+    no padded-compute penalty worth the bookkeeping on TPU)."""
+
+    def __init__(self, pack_output=False, relu=False, dropout=0.0):
+        if pack_output:
+            raise NotImplementedError(
+                "packed output is a CUDA memory-layout optimization; "
+                "on TPU use the padded layout")
+        self.relu = relu
+        self.dropout = dropout
+
+    def __call__(self, f, g, f_len=None, g_len=None, dropout_key=None,
+                 is_training=True):
+        h = f[:, :, None, :] + g[:, None, :, :]
+        if self.relu:
+            h = jnp.maximum(h, 0)
+        if self.dropout and is_training and dropout_key is not None:
+            keep = 1.0 - self.dropout
+            mask = jax.random.bernoulli(dropout_key, keep, h.shape)
+            h = jnp.where(mask, h / keep, 0.0)
+        return h
+
+
+def _row_scan(a, b, x0):
+    """x[u] = logaddexp(a[u], x[u-1] + b[u]), x[-1] = x0, via
+    associative composition of log-affine maps."""
+    def combine(l, r):
+        al, bl = l
+        ar, br = r
+        return jnp.logaddexp(ar, al + br), bl + br
+
+    a0 = jnp.logaddexp(a[..., 0], x0 + b[..., 0])
+    a_rest = a[..., 1:]
+    b_rest = b[..., 1:]
+    a_all = jnp.concatenate([a0[..., None], a_rest], axis=-1)
+    b_all = jnp.concatenate([jnp.zeros_like(b[..., :1]), b_rest], axis=-1)
+    res_a, _ = lax.associative_scan(combine, (a_all, b_all), axis=-1)
+    return res_a
+
+
+def transducer_loss(log_probs, labels, f_len, y_len, blank_idx: int = 0):
+    """RNN-T loss ≡ TransducerLoss (transducer.py:68-130).
+
+    log_probs: (B, T, U+1, V) log-softmax over vocab;
+    labels: (B, U) int; f_len: (B,) valid T; y_len: (B,) valid U.
+    Returns per-sample negative log likelihood (B,).
+    """
+    B, T, U1, V = log_probs.shape
+    U = U1 - 1  # label positions
+    blank = log_probs[..., blank_idx]                       # (B, T, U+1)
+    lbl = jnp.take_along_axis(
+        log_probs[:, :, :U, :],
+        jnp.broadcast_to(labels[:, None, :, None], (B, T, U, 1)),
+        axis=-1)[..., 0]                                    # (B, T, U)
+    # mask invalid label positions (u >= y_len): emitting there is
+    # impossible
+    u_idx = jnp.arange(U)[None, None, :]
+    lbl = jnp.where(u_idx < y_len[:, None, None], lbl, _NEG)
+
+    # alpha[0, u] = cumsum of label emissions along u at t=0
+    a0 = jnp.concatenate(
+        [jnp.zeros((B, 1)), jnp.cumsum(lbl[:, 0, :], axis=-1)], axis=-1)
+
+    def step(alpha_prev, t):
+        # A[u] = alpha[t-1, u] + blank[t-1, u]  (time transition)
+        A = alpha_prev + blank[:, t - 1, :]
+        # row recurrence: alpha[t, u] = logaddexp(A[u], alpha[t,u-1]
+        #                                         + lbl[t, u-1])
+        a_first = A[:, :1]                                   # u = 0
+        a_rest = _row_scan(A[:, 1:], lbl[:, t, :], a_first[:, 0])
+        alpha_t = jnp.concatenate([a_first, a_rest], axis=-1)
+        return alpha_t, alpha_t
+
+    _, alphas = lax.scan(step, a0, jnp.arange(1, T))
+    alphas = jnp.concatenate([a0[None], alphas], axis=0)     # (T, B, U+1)
+    alphas = alphas.transpose(1, 0, 2)                       # (B, T, U+1)
+
+    # NLL = -(alpha[f_len-1, y_len] + blank[f_len-1, y_len])
+    t_last = jnp.maximum(f_len - 1, 0)
+    a_final = jnp.take_along_axis(
+        alphas, t_last[:, None, None], axis=1)[:, 0, :]      # (B, U+1)
+    a_final = jnp.take_along_axis(a_final, y_len[:, None], axis=1)[:, 0]
+    b_final = jnp.take_along_axis(
+        blank, t_last[:, None, None], axis=1)[:, 0, :]
+    b_final = jnp.take_along_axis(b_final, y_len[:, None], axis=1)[:, 0]
+    return -(a_final + b_final)
+
+
+class TransducerLoss:
+    """Module facade ≡ TransducerLoss (transducer.py:68)."""
+
+    def __init__(self, packed_input=False):
+        if packed_input:
+            raise NotImplementedError("packed input is a CUDA layout "
+                                      "optimization; use padded on TPU")
+
+    def __call__(self, x, label, f_len, y_len, blank_idx=0):
+        return transducer_loss(x, label, f_len, y_len, blank_idx)

@@ -1,0 +1,182 @@
+"""Contrib op tests ≡ apex/contrib/test/*: multihead attention vs
+reference math, focal loss vs formula, index_mul_2d fwd/bwd, RNN-T
+transducer loss vs numpy DP oracle."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.contrib.focal_loss import focal_loss
+from apex_tpu.contrib.index_mul_2d import index_mul_2d
+from apex_tpu.contrib.multihead_attn import (
+    EncdecMultiheadAttn,
+    SelfMultiheadAttn,
+)
+from apex_tpu.contrib.transducer import TransducerJoint, transducer_loss
+
+
+# ---------------------------- multihead attn --------------------------------
+
+def _ref_self_attn(params, x, nh, norm_add=False):
+    """Pure reference math (≡ the python fallback in multihead_attn)."""
+    from apex_tpu.ops.layer_norm import layer_norm_reference
+    residual = x
+    if norm_add:
+        x = layer_norm_reference(x, params["ln"]["weight"],
+                                 params["ln"]["bias"])
+    s, b, e = x.shape
+    hd = e // nh
+    qkv = x @ params["qkv_weight"]
+    qkv = qkv.reshape(s, b, 3, nh, hd)
+    q, k, v = (qkv[:, :, i].transpose(1, 2, 0, 3) for i in range(3))
+    sc = jnp.einsum("bnqd,bnkd->bnqk", q, k) * (hd ** -0.5)
+    p = jax.nn.softmax(sc, axis=-1)
+    ctx = jnp.einsum("bnqk,bnkd->bnqd", p, v)
+    out = ctx.transpose(2, 0, 1, 3).reshape(s, b, e) @ params["out_weight"]
+    if norm_add:
+        out = out + residual
+    return out
+
+
+@pytest.mark.parametrize("norm_add", [False, True])
+def test_self_multihead_attn(norm_add):
+    mha = SelfMultiheadAttn(32, 4, bias=False, include_norm_add=norm_add)
+    p = mha.init(jax.random.PRNGKey(0))
+    x = jax.random.normal(jax.random.PRNGKey(1), (16, 2, 32))
+    got = mha.apply(p, x, use_pallas_override=True)
+    want = _ref_self_attn(p, x, 4, norm_add)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_encdec_multihead_attn():
+    mha = EncdecMultiheadAttn(32, 4, bias=True)
+    p = mha.init(jax.random.PRNGKey(2))
+    q = jax.random.normal(jax.random.PRNGKey(3), (8, 2, 32))
+    enc = jax.random.normal(jax.random.PRNGKey(4), (16, 2, 32))
+    out = mha.apply(p, q, key=enc, use_pallas_override=True)
+    assert out.shape == (8, 2, 32)
+    # grads flow to all params
+    g = jax.grad(lambda pp: jnp.sum(mha.apply(
+        pp, q, key=enc, use_pallas_override=True) ** 2))(p)
+    assert all(np.abs(np.asarray(l)).max() > 0
+               for l in jax.tree_util.tree_leaves(g))
+
+
+def test_self_attn_with_mask():
+    mha = SelfMultiheadAttn(16, 2)
+    p = mha.init(jax.random.PRNGKey(5))
+    x = jax.random.normal(jax.random.PRNGKey(6), (8, 2, 16))
+    mask = jnp.zeros((2, 1, 8, 8), bool).at[:, :, :, 6:].set(True)
+    out = mha.apply(p, x, mask=mask)
+    # masked keys don't affect output: perturb x at masked positions
+    x2 = x.at[6:].set(0.0)
+    out2 = mha.apply(p, x2, mask=mask)
+    np.testing.assert_allclose(np.asarray(out[:6]), np.asarray(out2[:6]),
+                               rtol=1e-4, atol=1e-5)
+
+
+# ------------------------------ focal loss ----------------------------------
+
+def test_focal_loss_matches_formula():
+    x = jax.random.normal(jax.random.PRNGKey(7), (10, 8))
+    t = jnp.array([0, 1, 2, -1, -1, 3, -2, 7, 0, -1])
+    nps = jnp.float32(4.0)
+    got = float(focal_loss(x, t, nps, 8))
+
+    xx = np.asarray(x, np.float64)
+    want = 0.0
+    for i in range(10):
+        if int(t[i]) == -2:
+            continue
+        y = np.zeros(8)
+        if int(t[i]) >= 0:
+            y[int(t[i])] = 1.0
+        p = 1 / (1 + np.exp(-xx[i]))
+        ce = -(y * np.log(p) + (1 - y) * np.log(1 - p))
+        pt = p * y + (1 - p) * (1 - y)
+        at = 0.25 * y + 0.75 * (1 - y)
+        want += np.sum(at * (1 - pt) ** 2 * ce)
+    np.testing.assert_allclose(got, want / 4.0, rtol=1e-4)
+
+
+# ------------------------------ index_mul_2d --------------------------------
+
+def test_index_mul_2d():
+    in1 = jax.random.normal(jax.random.PRNGKey(8), (10, 4))
+    in2 = jax.random.normal(jax.random.PRNGKey(9), (6, 4))
+    idx = jnp.array([0, 3, 3, 9, 1, 0])
+    got = index_mul_2d(in1, in2, idx)
+    np.testing.assert_allclose(np.asarray(got),
+                               np.asarray(in1)[np.asarray(idx)]
+                               * np.asarray(in2), rtol=1e-6)
+
+    def loss(a, b):
+        return jnp.sum(jnp.sin(index_mul_2d(a, b, idx)))
+
+    g1 = jax.grad(loss, argnums=(0, 1))(in1, in2)
+    g2 = jax.grad(lambda a, b: jnp.sum(jnp.sin(
+        jnp.take(a, idx, 0) * b)), argnums=(0, 1))(in1, in2)
+    for a, e in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(e),
+                                   rtol=1e-5, atol=1e-6)
+
+
+# ------------------------------ transducer ----------------------------------
+
+def _rnnt_dp(log_probs, labels, T, U, blank=0):
+    """Numpy alpha DP oracle (standard RNN-T forward variable)."""
+    lp = np.asarray(log_probs, np.float64)
+    alpha = np.full((T, U + 1), -np.inf)
+    alpha[0, 0] = 0.0
+    for u in range(1, U + 1):
+        alpha[0, u] = alpha[0, u - 1] + lp[0, u - 1, labels[u - 1]]
+    for t in range(1, T):
+        alpha[t, 0] = alpha[t - 1, 0] + lp[t - 1, 0, blank]
+        for u in range(1, U + 1):
+            a = alpha[t - 1, u] + lp[t - 1, u, blank]
+            b = alpha[t, u - 1] + lp[t, u - 1, labels[u - 1]]
+            alpha[t, u] = np.logaddexp(a, b)
+    return -(alpha[T - 1, U] + lp[T - 1, U, blank])
+
+
+def test_transducer_loss_vs_dp():
+    B, T, U, V = 3, 5, 4, 7
+    x = jax.random.normal(jax.random.PRNGKey(10), (B, T, U + 1, V))
+    log_probs = jax.nn.log_softmax(x, axis=-1)
+    labels = jax.random.randint(jax.random.PRNGKey(11), (B, U), 1, V)
+    f_len = jnp.array([5, 4, 3])
+    y_len = jnp.array([4, 3, 2])
+    got = transducer_loss(log_probs, labels, f_len, y_len)
+    for i in range(B):
+        want = _rnnt_dp(np.asarray(log_probs[i]), np.asarray(labels[i]),
+                        int(f_len[i]), int(y_len[i]))
+        np.testing.assert_allclose(float(got[i]), want, rtol=1e-4,
+                                   err_msg=f"sample {i}")
+
+
+def test_transducer_loss_grad_finite():
+    B, T, U, V = 2, 4, 3, 5
+    x = jax.random.normal(jax.random.PRNGKey(12), (B, T, U + 1, V))
+    labels = jax.random.randint(jax.random.PRNGKey(13), (B, U), 1, V)
+    f_len = jnp.array([4, 4])
+    y_len = jnp.array([3, 3])
+
+    def loss(x):
+        lp = jax.nn.log_softmax(x, axis=-1)
+        return jnp.mean(transducer_loss(lp, labels, f_len, y_len))
+
+    g = jax.grad(loss)(x)
+    assert np.isfinite(np.asarray(g)).all()
+
+
+def test_transducer_joint():
+    f = jax.random.normal(jax.random.PRNGKey(14), (2, 4, 8))
+    g = jax.random.normal(jax.random.PRNGKey(15), (2, 3, 8))
+    joint = TransducerJoint(relu=True)
+    h = joint(f, g)
+    assert h.shape == (2, 4, 3, 8)
+    want = np.maximum(np.asarray(f)[:, :, None] + np.asarray(g)[:, None],
+                      0)
+    np.testing.assert_allclose(np.asarray(h), want, rtol=1e-6)
