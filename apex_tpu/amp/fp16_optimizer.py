@@ -1,0 +1,65 @@
+"""FP16_Optimizer — manual master-weight optimizer facade.
+
+≡ apex.fp16_utils.FP16_Optimizer (apex/fp16_utils/fp16_optimizer.py:13)
+and the deprecated apex.contrib.optimizers.FP16_Optimizer: wraps an
+inner optimizer with fp32 master weights, (dynamic) loss scaling, and
+overflow-skipping.  In this framework the fused optimizers already keep
+fp32 flat masters, so this class is the *workflow* facade: scale →
+backward (caller) → clip/unscale → masked step → scaler update,
+with state_dict parity.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.amp import scaler as scaler_lib
+from apex_tpu.parallel.clip_grad import clip_grad_norm
+
+
+class FP16_Optimizer:
+    def __init__(self, init_optimizer, static_loss_scale: float = 1.0,
+                 dynamic_loss_scale: bool = False,
+                 dynamic_loss_args: Optional[dict] = None,
+                 verbose: bool = False):
+        self.optimizer = init_optimizer
+        self.dynamic = dynamic_loss_scale
+        self.scaler_state = scaler_lib.init(
+            "dynamic" if dynamic_loss_scale else static_loss_scale)
+        self.clip_grad_norm_value = None
+
+    @property
+    def loss_scale(self):
+        return float(self.scaler_state.scale)
+
+    def init(self, params):
+        return self.optimizer.init(params)
+
+    def scale_loss(self, loss):
+        """≡ FP16_Optimizer.backward's loss*scale (the backward itself is
+        the caller's jax.grad)."""
+        return scaler_lib.scale_loss(self.scaler_state, loss)
+
+    def step(self, state, grads, lr=None, max_grad_norm=None):
+        """Unscale, (optionally clip), masked step, update scaler.
+        Returns (params, state)."""
+        grads, found_inf = scaler_lib.unscale(self.scaler_state, grads)
+        if max_grad_norm:
+            grads, _ = clip_grad_norm(grads, max_grad_norm)
+        params, new_state = self.optimizer.step(
+            state, grads, lr=lr, found_inf=found_inf)
+        self.scaler_state = scaler_lib.update(
+            self.scaler_state, found_inf, dynamic=self.dynamic)
+        return params, new_state
+
+    # -- checkpoint parity (fp16_optimizer.py state_dict incl. masters) --
+    def state_dict(self, state):
+        return {"optimizer": self.optimizer.state_dict(state),
+                "loss_scaler": scaler_lib.state_dict(self.scaler_state)}
+
+    def load_state_dict(self, d):
+        self.scaler_state = scaler_lib.load_state_dict(d["loss_scaler"])
+        return self.optimizer.load_state_dict(d["optimizer"])

@@ -1,0 +1,134 @@
+"""ASP — automatic 2:4 structured sparsity.
+
+≡ apex.contrib.sparsity (apex/contrib/sparsity/asp.py:40-213,
+sparse_masklib.py, permutation_lib.py + CUDA search kernels): computes
+2:4 (n:m) sparsity masks for weight matrices, wraps the optimizer step
+to re-apply masks, and searches channel permutations that preserve
+accuracy.  TPU version: mask computation and the greedy permutation
+search are XLA reductions/sorts (the CUDA kernels were brute-force
+scorers); the optimizer hook becomes a functional mask-apply after each
+step.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def create_mask(weight, pattern: str = "m4n2_1d"):
+    """2:4 mask along the input dim ≡ sparse_masklib.create_mask.
+
+    m4n2_1d: in every group of 4 consecutive elements of each row, keep
+    the 2 with largest magnitude.
+    """
+    if pattern not in ("m4n2_1d", "m4n2"):
+        raise NotImplementedError(f"pattern {pattern}")
+    w = jnp.abs(weight)
+    orig = w.shape
+    m, n = 4, 2
+    flat = w.reshape(-1, m)
+    # rank within each group; keep top-n
+    order = jnp.argsort(flat, axis=-1)  # ascending
+    ranks = jnp.zeros_like(order).at[
+        jnp.arange(flat.shape[0])[:, None], order].set(
+        jnp.broadcast_to(jnp.arange(m), flat.shape))
+    mask = (ranks >= (m - n)).astype(weight.dtype)
+    return mask.reshape(orig)
+
+
+def apply_mask(weight, mask):
+    return weight * mask
+
+
+def magnitude_after_mask(weight, mask=None):
+    if mask is None:
+        mask = create_mask(weight)
+    return jnp.sum(jnp.abs(weight) * mask)
+
+
+def search_channel_permutation(weight, num_iters: int = 100,
+                               seed: int = 0):
+    """Greedy column-permutation search maximizing retained magnitude
+    under the 2:4 mask ≡ permutation_lib.Permutation +
+    permutation_search_kernels (CUDA brute-force scorers → vectorized
+    jnp scoring).  Returns (permutation, score)."""
+    c = weight.shape[-1]
+    perm = np.arange(c)
+    w = np.asarray(weight, np.float32)
+
+    def score(p):
+        return float(magnitude_after_mask(jnp.asarray(w[:, p])))
+
+    best = score(perm)
+    rng = np.random.RandomState(seed)
+    for _ in range(num_iters):
+        i, j = rng.randint(0, c, 2)
+        if i == j:
+            continue
+        cand = perm.copy()
+        cand[i], cand[j] = cand[j], cand[i]
+        s = score(cand)
+        if s > best:
+            best, perm = s, cand
+    return perm, best
+
+
+class ASP:
+    """≡ apex.contrib.sparsity.ASP (asp.py): functional variant.
+
+    asp = ASP(); params = asp.init_model_for_pruning(params, whitelist)
+    computes masks; asp.apply(params) re-applies them (call after every
+    optimizer step ≡ the wrapped optimizer.step, asp.py:185-211).
+    """
+
+    def __init__(self, mask_calculator: str = "m4n2_1d",
+                 allow_permutation: bool = False):
+        self.pattern = mask_calculator
+        self.allow_permutation = allow_permutation
+        self.masks = {}
+
+    def _eligible(self, path, leaf, whitelist):
+        name = "/".join(str(p) for p in path).lower()
+        if leaf.ndim < 2:
+            return False
+        if min(leaf.shape[-2:]) % 4 != 0:
+            return False
+        if whitelist is None:
+            return "weight" in name or name.endswith("w")
+        return any(w in name for w in whitelist)
+
+    def init_model_for_pruning(self, params, whitelist=None):
+        """Compute masks ≡ ASP.init_model_for_pruning (asp.py:40-182) +
+        compute_sparse_masks (asp.py:213)."""
+        self.masks = {}
+
+        def visit(path, leaf):
+            if self._eligible(path, leaf, whitelist):
+                key = tuple(str(p) for p in path)
+                self.masks[key] = create_mask(leaf, self.pattern)
+                return leaf * self.masks[key]
+            return leaf
+
+        return jax.tree_util.tree_map_with_path(visit, params)
+
+    def apply(self, params):
+        """Re-apply masks after an optimizer step ≡ the wrapped step."""
+        def visit(path, leaf):
+            key = tuple(str(p) for p in path)
+            if key in self.masks:
+                return leaf * self.masks[key]
+            return leaf
+
+        return jax.tree_util.tree_map_with_path(visit, params)
+
+    def sparsity(self, params):
+        """Fraction of zeros in masked leaves."""
+        zeros = total = 0
+        for key, mask in self.masks.items():
+            zeros += float(jnp.sum(mask == 0))
+            total += mask.size
+        return zeros / max(total, 1)

@@ -1,0 +1,173 @@
+"""apex_tpu.rnn — low-precision-friendly RNN/LSTM/GRU/mLSTM.
+
+≡ apex.RNN (apex/RNN/models.py:21-49, RNNBackend.py:25-232): a pure
+re-implementation of the cuDNN RNN zoo whose point was fp16 safety
+(explicit cell math instead of opaque cuDNN calls).  TPU version: cells
+as `lax.scan` bodies — XLA fuses the gate math and the scan keeps
+everything on-device; bf16-safe by construction (fp32 cell state).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _linear_init(key, shape, dtype):
+    bound = 1.0 / math.sqrt(shape[0])
+    return jax.random.uniform(key, shape, dtype, -bound, bound)
+
+
+class _RNNBase:
+    """Common init/apply over a cell ≡ RNNBackend.RNNCell/stackedRNN."""
+
+    gate_mult = 1
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 bidirectional=False):
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.bidirectional = bidirectional
+
+    def init(self, key, dtype=jnp.float32):
+        params = []
+        n_dir = 2 if self.bidirectional else 1
+        for layer in range(self.num_layers):
+            for d in range(n_dir):
+                key, k1, k2, k3, k4 = jax.random.split(key, 5)
+                in_dim = self.input_size if layer == 0 \
+                    else self.hidden_size * n_dir
+                g = self.gate_mult * self.hidden_size
+                params.append({
+                    "w_ih": _linear_init(k1, (in_dim, g), dtype),
+                    "w_hh": _linear_init(k2, (self.hidden_size, g), dtype),
+                    "b_ih": jnp.zeros((g,), dtype),
+                    "b_hh": jnp.zeros((g,), dtype),
+                })
+        return params
+
+    def _cell(self, p, x_t, state):
+        raise NotImplementedError
+
+    def _init_state(self, batch):
+        return jnp.zeros((batch, self.hidden_size), jnp.float32)
+
+    def _run_dir(self, p, xs, reverse=False):
+        batch = xs.shape[1]
+        state0 = self._init_state(batch)
+
+        def step(state, x_t):
+            new_state, out = self._cell(p, x_t, state)
+            return new_state, out
+
+        _, outs = lax.scan(step, state0, xs, reverse=reverse)
+        return outs
+
+    def apply(self, params, x):
+        """x: (S, B, input_size) → (S, B, H * n_dir)."""
+        n_dir = 2 if self.bidirectional else 1
+        h = x
+        for layer in range(self.num_layers):
+            outs = []
+            for d in range(n_dir):
+                p = params[layer * n_dir + d]
+                outs.append(self._run_dir(p, h, reverse=(d == 1)))
+            h = jnp.concatenate(outs, axis=-1) if n_dir == 2 else outs[0]
+        return h
+
+
+class RNNReLU(_RNNBase):
+    """≡ apex.RNN.ReLU (models.py)."""
+
+    def _cell(self, p, x_t, h):
+        g = x_t @ p["w_ih"] + p["b_ih"] + h.astype(x_t.dtype) @ p["w_hh"] \
+            + p["b_hh"]
+        h_new = jnp.maximum(g.astype(jnp.float32), 0)
+        return h_new, h_new.astype(x_t.dtype)
+
+
+class RNNTanh(_RNNBase):
+    """≡ apex.RNN.Tanh."""
+
+    def _cell(self, p, x_t, h):
+        g = x_t @ p["w_ih"] + p["b_ih"] + h.astype(x_t.dtype) @ p["w_hh"] \
+            + p["b_hh"]
+        h_new = jnp.tanh(g.astype(jnp.float32))
+        return h_new, h_new.astype(x_t.dtype)
+
+
+class LSTM(_RNNBase):
+    """≡ apex.RNN.LSTM (models.py:21)."""
+
+    gate_mult = 4
+
+    def _init_state(self, batch):
+        z = jnp.zeros((batch, self.hidden_size), jnp.float32)
+        return (z, z)
+
+    def _cell(self, p, x_t, state):
+        h, c = state
+        g = (x_t @ p["w_ih"] + p["b_ih"]
+             + h.astype(x_t.dtype) @ p["w_hh"] + p["b_hh"]
+             ).astype(jnp.float32)
+        i, f, gg, o = jnp.split(g, 4, axis=-1)
+        i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+        c_new = f * c + i * jnp.tanh(gg)
+        h_new = o * jnp.tanh(c_new)
+        return (h_new, c_new), h_new.astype(x_t.dtype)
+
+
+class GRU(_RNNBase):
+    """≡ apex.RNN.GRU."""
+
+    gate_mult = 3
+
+    def _cell(self, p, x_t, h):
+        gi = (x_t @ p["w_ih"] + p["b_ih"]).astype(jnp.float32)
+        gh = (h.astype(x_t.dtype) @ p["w_hh"] + p["b_hh"]
+              ).astype(jnp.float32)
+        ir, iz, in_ = jnp.split(gi, 3, axis=-1)
+        hr, hz, hn = jnp.split(gh, 3, axis=-1)
+        r = jax.nn.sigmoid(ir + hr)
+        z = jax.nn.sigmoid(iz + hz)
+        n = jnp.tanh(in_ + r * hn)
+        h_new = (1 - z) * n + z * h
+        return h_new, h_new.astype(x_t.dtype)
+
+
+class mLSTM(_RNNBase):
+    """≡ apex.RNN.mLSTM (multiplicative LSTM, models.py:49 +
+    RNNBackend.mLSTMRNNCell): m = (x W_mx) * (h W_mh) modulates the
+    hidden state fed to the gates."""
+
+    gate_mult = 4
+
+    def init(self, key, dtype=jnp.float32):
+        params = super().init(key, dtype)
+        for layer, p in enumerate(params):
+            in_dim = self.input_size if layer == 0 else self.hidden_size
+            key, k1, k2 = jax.random.split(key, 3)
+            p["w_mx"] = _linear_init(k1, (in_dim, self.hidden_size), dtype)
+            p["w_mh"] = _linear_init(k2, (self.hidden_size,
+                                          self.hidden_size), dtype)
+        return params
+
+    def _init_state(self, batch):
+        z = jnp.zeros((batch, self.hidden_size), jnp.float32)
+        return (z, z)
+
+    def _cell(self, p, x_t, state):
+        h, c = state
+        m = (x_t @ p["w_mx"]) * (h.astype(x_t.dtype) @ p["w_mh"])
+        g = (x_t @ p["w_ih"] + p["b_ih"] + m @ p["w_hh"] + p["b_hh"]
+             ).astype(jnp.float32)
+        i, f, gg, o = jnp.split(g, 4, axis=-1)
+        i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+        c_new = f * c + i * jnp.tanh(gg)
+        h_new = o * jnp.tanh(c_new)
+        return (h_new, c_new), h_new.astype(x_t.dtype)

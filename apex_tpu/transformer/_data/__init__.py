@@ -1,0 +1,4 @@
+from apex_tpu.transformer._data._batchsampler import (  # noqa: F401
+    MegatronPretrainingRandomSampler,
+    MegatronPretrainingSampler,
+)

@@ -1,0 +1,1 @@
+from apex_tpu.transformer.amp.grad_scaler import GradScaler  # noqa: F401

@@ -1,0 +1,57 @@
+"""Model-parallel-aware grad scaler.
+
+≡ apex/transformer/amp/grad_scaler.py:21-79 (GradScaler): a torch
+GradScaler subclass whose only change is all-reducing found_inf over the
+model-parallel group before the step/update decision — so a tp/pp rank
+that overflows makes EVERY rank skip in lockstep.
+
+TPU version: the same rule as a pure function over the functional
+scaler state: `found_inf` is psum'd over the tp and pp axes inside the
+SPMD region.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.amp import scaler as scaler_lib
+from apex_tpu.parallel.mesh import PP_AXIS, TP_AXIS
+
+
+def allreduce_found_inf(found_inf, axis_names=(TP_AXIS, PP_AXIS)):
+    """≡ GradScaler._unscale_grads_'s MP-group allreduce
+    (grad_scaler.py:44-55).  Call inside shard_map."""
+    flag = jnp.asarray(found_inf, jnp.float32)
+    for ax in axis_names:
+        flag = jax.lax.pmax(flag, ax)
+    return flag > 0.5
+
+
+class GradScaler:
+    """Functional facade matching the reference class shape."""
+
+    def __init__(self, init_scale=2.0 ** 16, growth_factor=2.0,
+                 backoff_factor=0.5, growth_interval=2000, enabled=True):
+        self.enabled = enabled
+        self.growth_factor = growth_factor
+        self.backoff_factor = backoff_factor
+        self.growth_interval = growth_interval
+        self.state = scaler_lib.init("dynamic" if enabled else None,
+                                     init_scale=init_scale)
+
+    def scale(self, loss):
+        return scaler_lib.scale_loss(self.state, loss) if self.enabled \
+            else loss
+
+    def unscale_and_sync(self, grads, axis_names=(TP_AXIS, PP_AXIS)):
+        grads, found_inf = scaler_lib.unscale(self.state, grads)
+        return grads, allreduce_found_inf(found_inf, axis_names)
+
+    def update(self, found_inf):
+        self.state = scaler_lib.update(
+            self.state, found_inf, dynamic=self.enabled,
+            growth_interval=self.growth_interval,
+            growth_factor=self.growth_factor,
+            backoff_factor=self.backoff_factor)
+        return self.state

@@ -1,0 +1,20 @@
+"""apex_tpu.transformer.testing ≡ apex/transformer/testing: standalone
+models, toy modules, arg parsing, and global state for tests/harnesses."""
+
+from apex_tpu.transformer.testing.commons import (  # noqa: F401
+    IdentityLayer,
+    MyLayer,
+    MyModel,
+    ToyParallelMLP,
+    set_random_seed,
+)
+from apex_tpu.transformer.testing.global_vars import (  # noqa: F401
+    get_args,
+    get_timers,
+    set_global_variables,
+)
+
+# standalone flagship models live in apex_tpu.models; aliased here for
+# layout parity with the reference (standalone_gpt.py / standalone_bert.py)
+from apex_tpu.models.gpt import GPT as StandaloneGPT  # noqa: F401
+from apex_tpu.models.bert import Bert as StandaloneBert  # noqa: F401

@@ -1,0 +1,93 @@
+"""Test-support modules and helpers.
+
+≡ apex/transformer/testing/commons.py:44-291: toy models
+(MyLayer/MyModel/ToyParallelMLP), IdentityLayer, deterministic seeding.
+The process-spawning DistributedTestBase (distributed_test_base.py:22-126)
+has no TPU analogue — the 8-device virtual CPU mesh in tests/conftest.py
+replaces multi-process NCCL spawning entirely.
+"""
+
+from __future__ import annotations
+
+import random
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from apex_tpu.transformer.tensor_parallel.layers import (
+    ColumnParallelLinear,
+    RowParallelLinear,
+)
+
+
+def set_random_seed(seed: int):
+    """≡ commons.set_random_seed (commons.py:242)."""
+    random.seed(seed)
+    np.random.seed(seed)
+    return jax.random.PRNGKey(seed)
+
+
+class IdentityLayer:
+    """≡ commons.IdentityLayer (commons.py:233): a learnable tensor."""
+
+    def __init__(self, size, scale=1.0):
+        self.size = size
+        self.scale = scale
+
+    def init(self, key):
+        return {"weight": self.scale * jax.random.normal(key, self.size)}
+
+    def apply(self, params):
+        return params["weight"]
+
+
+class MyLayer:
+    """≡ commons.MyLayer: one linear, shape-preserving."""
+
+    def __init__(self, hidden_size):
+        self.hidden_size = hidden_size
+
+    def init(self, key):
+        return {"w": jax.random.normal(key, (self.hidden_size,
+                                             self.hidden_size)) * 0.1,
+                "b": jnp.zeros((self.hidden_size,))}
+
+    def apply(self, params, x):
+        return x @ params["w"] + params["b"]
+
+
+class MyModel:
+    """≡ commons.MyModel: stacked MyLayers (pipeline test fodder)."""
+
+    def __init__(self, hidden_size, num_layers=1):
+        self.layers = [MyLayer(hidden_size) for _ in range(num_layers)]
+
+    def init(self, key):
+        ks = jax.random.split(key, len(self.layers))
+        return [l.init(k) for l, k in zip(self.layers, ks)]
+
+    def apply(self, params, x):
+        for l, p in zip(self.layers, params):
+            x = l.apply(p, x)
+        return x
+
+
+class ToyParallelMLP:
+    """≡ commons.ToyParallelMLP (commons.py:44-155): col→gelu→row."""
+
+    def __init__(self, hidden_size, sequence_parallel=False):
+        self.col = ColumnParallelLinear(hidden_size, 4 * hidden_size,
+                                        gather_output=False,
+                                        sequence_parallel=sequence_parallel)
+        self.row = RowParallelLinear(4 * hidden_size, hidden_size,
+                                     input_is_parallel=True,
+                                     sequence_parallel=sequence_parallel)
+
+    def init(self, key):
+        k1, k2 = jax.random.split(key)
+        return {"col": self.col.init(k1), "row": self.row.init(k2)}
+
+    def apply(self, params, x):
+        return self.row.apply(params["row"],
+                              jax.nn.gelu(self.col.apply(params["col"], x)))

@@ -1,0 +1,46 @@
+"""Global args/timers registry.
+
+≡ apex/transformer/testing/global_vars.py:26-60: the Megatron-style
+global `args`, timers, and tensorboard-writer singletons.
+"""
+
+from __future__ import annotations
+
+from apex_tpu.utils.timers import Timers
+
+_GLOBAL_ARGS = None
+_GLOBAL_TIMERS = None
+_GLOBAL_TENSORBOARD_WRITER = None
+_GLOBAL_AUTORESUME = None
+
+
+def get_args():
+    """≡ global_vars.get_args."""
+    assert _GLOBAL_ARGS is not None, "args is not initialized."
+    return _GLOBAL_ARGS
+
+
+def get_timers():
+    assert _GLOBAL_TIMERS is not None, "timers is not initialized."
+    return _GLOBAL_TIMERS
+
+
+def get_tensorboard_writer():
+    return _GLOBAL_TENSORBOARD_WRITER
+
+
+def get_adlr_autoresume():
+    return _GLOBAL_AUTORESUME
+
+
+def set_global_variables(args=None, extra_args_provider=None, defaults={},
+                         ignore_unknown_args=False):
+    """≡ global_vars.set_global_variables (26-47)."""
+    global _GLOBAL_ARGS, _GLOBAL_TIMERS
+    if args is None:
+        from apex_tpu.transformer.testing.arguments import parse_args
+        args = parse_args(extra_args_provider, defaults,
+                          ignore_unknown_args)
+    _GLOBAL_ARGS = args
+    _GLOBAL_TIMERS = Timers()
+    return args

@@ -1,0 +1,2 @@
+from apex_tpu.utils.timers import Timers, _Timer  # noqa: F401
+from apex_tpu.utils.log_util import get_transformer_logger, set_logging_level  # noqa: F401

@@ -1,0 +1,32 @@
+"""Per-module loggers with env-var verbosity.
+
+≡ apex/transformer/log_util.py:5-20 (get_transformer_logger,
+set_logging_level) + the rank-info formatter in apex/__init__.py:31-43.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+
+def get_transformer_logger(name: str) -> logging.Logger:
+    name_wo_ext = os.path.splitext(name)[0]
+    return logging.getLogger(name_wo_ext)
+
+
+def set_logging_level(verbosity) -> None:
+    """≡ log_util.set_logging_level: APEX_TPU_VERBOSITY env or explicit."""
+    from apex_tpu import RankInfoFormatter
+    logger = logging.getLogger("apex_tpu")
+    logger.setLevel(verbosity)
+    if not logger.handlers:
+        handler = logging.StreamHandler()
+        handler.setFormatter(RankInfoFormatter(
+            "%(asctime)s [%(rank_info)s] %(levelname)s %(name)s: %(message)s"))
+        logger.addHandler(handler)
+
+
+_env = os.environ.get("APEX_TPU_VERBOSITY")
+if _env:
+    set_logging_level(_env)

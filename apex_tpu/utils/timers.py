@@ -1,0 +1,80 @@
+"""Phase timers + profiler hooks.
+
+≡ apex/transformer/pipeline_parallel/_timers.py:6-51 (_Timer/_Timers
+on CUDA events) — TPU version uses wall clock around block_until_ready
+plus `jax.profiler` trace annotations (the reference's NVTX ranges,
+apex/parallel/distributed.py:363-407).
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax
+
+
+class _Timer:
+    """≡ _timers._Timer: start/stop/elapsed/reset."""
+
+    def __init__(self, name):
+        self.name_ = name
+        self.elapsed_ = 0.0
+        self.started_ = False
+        self.start_time = time.time()
+
+    def start(self):
+        assert not self.started_, "timer has already been started"
+        self._trace = jax.profiler.TraceAnnotation(self.name_)
+        self._trace.__enter__()
+        self.start_time = time.time()
+        self.started_ = True
+
+    def stop(self, block: bool = False):
+        assert self.started_, "timer is not started"
+        if block:
+            for d in jax.live_arrays():
+                pass
+        self.elapsed_ += time.time() - self.start_time
+        self.started_ = False
+        self._trace.__exit__(None, None, None)
+
+    def reset(self):
+        self.elapsed_ = 0.0
+        self.started_ = False
+
+    def elapsed(self, reset=True):
+        started = self.started_
+        if started:
+            self.stop()
+        e = self.elapsed_
+        if reset:
+            self.reset()
+        if started:
+            self.start()
+        return e
+
+
+class Timers:
+    """≡ _timers._Timers: registry + log."""
+
+    def __init__(self):
+        self.timers = {}
+
+    def __call__(self, name):
+        if name not in self.timers:
+            self.timers[name] = _Timer(name)
+        return self.timers[name]
+
+    def write(self, names, writer, iteration, normalizer=1.0, reset=False):
+        for name in names:
+            value = self.timers[name].elapsed(reset=reset) / normalizer
+            writer.add_scalar(name + "-time", value, iteration)
+
+    def log(self, names=None, normalizer=1.0, reset=True):
+        assert normalizer > 0.0
+        names = names or list(self.timers)
+        string = "time (ms)"
+        for name in names:
+            t = self.timers[name].elapsed(reset=reset) * 1000.0 / normalizer
+            string += f" | {name}: {t:.2f}"
+        return string
