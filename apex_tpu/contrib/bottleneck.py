@@ -1,0 +1,98 @@
+"""Fused ResNet bottleneck + spatial-parallel convolution with halo
+exchange.
+
+≡ apex.contrib.bottleneck (apex/contrib/bottleneck/bottleneck.py:134
+Bottleneck, 603 SpatialBottleneck; halo_exchangers.py:11-127
+HaloExchanger{NoComm,AllGather,SendRecv,Peer}; fast_bottleneck 4.1k LoC
+cudnn-frontend CUDA): the fused block is apex_tpu.models.resnet.Bottleneck
+(XLA fuses conv+BN+ReLU chains); this module adds the SPATIAL variant —
+input images sharded along H across a mesh axis, 3x3 convs exchanging
+one-row halos with ring neighbours.  The four CUDA halo transports
+(allgather / sendrecv / NVLink peer memory / raw NCCL) collapse into one
+`lax.ppermute` over ICI (parallel/collectives.halo_exchange_1d).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from apex_tpu.models.resnet import Bottleneck, conv2d
+from apex_tpu.parallel.collectives import halo_exchange_1d
+
+
+def spatial_conv2d(x, w, axis_name: str, stride: int = 1):
+    """Conv over H-sharded NHWC input with halo exchange.
+
+    ≡ SpatialBottleneck's halo-exchanged 3x3 conv
+    (bottleneck.py:603-980).  Non-periodic: edge shards see zero halos
+    (SAME-padding semantics of the unsharded conv).
+    """
+    kh = w.shape[0]
+    if kh == 1:
+        return conv2d(x, w, stride=stride, padding="SAME")
+    halo = (kh - 1) // 2
+    n = lax.axis_size(axis_name)
+    rank = lax.axis_index(axis_name)
+    top, bot = halo_exchange_1d(x, axis_name, halo, dim=1)  # NHWC → H dim 1
+    top = jnp.where(rank == 0, jnp.zeros_like(top), top)
+    bot = jnp.where(rank == n - 1, jnp.zeros_like(bot), bot)
+    xh = jnp.concatenate([top, x, bot], axis=1)
+    # valid in H (halos provide the padding), SAME in W
+    return lax.conv_general_dilated(
+        xh, w, window_strides=(stride, stride),
+        padding=[(0, 0), (kh // 2, kh // 2)],
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+
+class SpatialBottleneck(Bottleneck):
+    """≡ SpatialBottleneck (bottleneck.py:603): Bottleneck whose 3x3 conv
+    runs on H-sharded activations.  Use inside shard_map with the image
+    H dim sharded on `spatial_axis`."""
+
+    def __init__(self, cin, width, stride=1, downsample=False,
+                 spatial_axis: str = "dp"):
+        super().__init__(cin, width, stride, downsample)
+        self.spatial_axis = spatial_axis
+
+    def apply(self, params, state, x, training, axis_name):
+        from apex_tpu.models.resnet import _bn_apply
+        new_state = {}
+        out = conv2d(x, params["conv1"])
+        out, new_state["bn1"] = _bn_apply(params["bn1"], state["bn1"], out,
+                                          training, axis_name)
+        out = jnp.maximum(out, 0)
+        out = spatial_conv2d(out, params["conv2"], self.spatial_axis,
+                             stride=self.stride)
+        out, new_state["bn2"] = _bn_apply(params["bn2"], state["bn2"], out,
+                                          training, axis_name)
+        out = jnp.maximum(out, 0)
+        out = conv2d(out, params["conv3"])
+        out, new_state["bn3"] = _bn_apply(params["bn3"], state["bn3"], out,
+                                          training, axis_name)
+        if self.downsample:
+            sc = conv2d(x, params["conv_ds"], stride=self.stride)
+            sc, new_state["bn_ds"] = _bn_apply(params["bn_ds"],
+                                               state["bn_ds"], sc,
+                                               training, axis_name)
+        else:
+            sc = x
+        return jnp.maximum(out + sc, 0), new_state
+
+
+class HaloExchanger:
+    """Facade over the ppermute halo exchange ≡ the HaloExchanger family
+    (halo_exchangers.py:11-127) — one transport on TPU."""
+
+    def __init__(self, axis_name: str):
+        self.axis_name = axis_name
+
+    def left_right_halo_exchange(self, x, halo: int, dim: int = 1):
+        return halo_exchange_1d(x, self.axis_name, halo, dim=dim)
+
+
+HaloExchangerNoComm = HaloExchangerAllGather = HaloExchangerSendRecv = \
+    HaloExchangerPeer = HaloExchanger

@@ -1,0 +1,4 @@
+"""≡ apex.contrib.clip_grad (apex/contrib/clip_grad/clip_grad.py:16) —
+re-export of the fused clip_grad_norm."""
+
+from apex_tpu.parallel.clip_grad import clip_grad_norm, clip_grad_norm_  # noqa: F401

@@ -1,0 +1,43 @@
+"""Fused conv + bias (+ mask) + relu.
+
+≡ apex.contrib.conv_bias_relu (apex/contrib/conv_bias_relu/conv_bias_relu.py:12-78,
+cudnn-frontend kernels csrc/conv_bias_relu/conv_bias_relu.cpp 2.1k LoC):
+on TPU every one of these is a single XLA fusion around the conv — the
+custom_vjp mirrors the reference's saved-tensor choices.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from apex_tpu.models.resnet import conv2d
+
+
+def conv_bias_relu(x, w, b, stride: int = 1, padding: str = "SAME"):
+    """≡ ConvBiasReLU_ (conv_bias_relu.py:12)."""
+    y = conv2d(x, w, stride=stride, padding=padding)
+    return jnp.maximum(y + b.reshape(1, 1, 1, -1), 0)
+
+
+def conv_bias(x, w, b, stride: int = 1, padding: str = "SAME"):
+    """≡ ConvBias_."""
+    return conv2d(x, w, stride=stride, padding=padding) \
+        + b.reshape(1, 1, 1, -1)
+
+
+def conv_bias_mask_relu(x, w, b, mask, stride: int = 1,
+                        padding: str = "SAME"):
+    """≡ ConvBiasMaskReLU_ (dropout-style mask before relu)."""
+    y = conv2d(x, w, stride=stride, padding=padding) \
+        + b.reshape(1, 1, 1, -1)
+    return jnp.maximum(y * mask, 0)
+
+
+def conv_frozen_scale_bias_relu(x, w, scale, bias, stride: int = 1,
+                                padding: str = "SAME"):
+    """≡ ConvFrozenScaleBiasReLU_ (frozen-BN inference fusion)."""
+    y = conv2d(x, w, stride=stride, padding=padding)
+    return jnp.maximum(y * scale.reshape(1, 1, 1, -1)
+                       + bias.reshape(1, 1, 1, -1), 0)

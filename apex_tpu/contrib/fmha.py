@@ -1,0 +1,34 @@
+"""FMHA facade ≡ apex.contrib.fmha (apex/contrib/fmha/fmha.py:33-72):
+fixed-size fused MHA (seq ≤ 512, head dim 64, fp16, sm80+) over packed
+variable-length batches.  The TPU kernel (ops/flash_attention.py) has no
+size cap; this facade keeps the reference's packed-QKV call shape.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from apex_tpu.ops.flash_attention import flash_attention
+
+
+class FMHAFun:
+    """≡ fmha.FMHAFun: qkv packed (total_tokens, 3, h, d) + cu_seqlens.
+    TPU version takes the padded dense layout (B, S, 3, h, d) — packing
+    is a CUDA memory trick; XLA prefers static shapes."""
+
+    @staticmethod
+    def apply(qkv, causal=False, softmax_scale=None):
+        q, k, v = (qkv[:, :, i].transpose(0, 2, 1, 3) for i in range(3))
+        o = flash_attention(q, k, v, causal=causal,
+                            softmax_scale=softmax_scale)
+        return o.transpose(0, 2, 1, 3)
+
+
+class FMHA:
+    """≡ fmha.FMHA (fmha.py:60)."""
+
+    def __init__(self, causal: bool = False):
+        self.causal = causal
+
+    def __call__(self, qkv, softmax_scale=None):
+        return FMHAFun.apply(qkv, self.causal, softmax_scale)

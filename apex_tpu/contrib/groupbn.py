@@ -1,0 +1,57 @@
+"""Group batch normalization (NHWC).
+
+≡ apex.contrib.groupbn.BatchNorm2d_NHWC (apex/contrib/groupbn/batch_norm.py:101,
+bnp extension: nhwc_batch_norm_kernel.h 2.7k LoC + CUDA-IPC peer stats)
+and apex.contrib.cudnn_gbn.GroupBatchNorm2d
+(apex/contrib/cudnn_gbn/batch_norm.py:44): BN whose statistics are
+shared across a SUB-GROUP of ranks (bn_group) rather than all of dp.
+
+TPU: stats merging across a subgroup is a psum over a dedicated mesh
+sub-axis — build the mesh with the dp axis split as (dp_outer, bn) and
+pass axis_name="bn"; the IPC peer-stat machinery is unnecessary.
+Fused add+relu epilogues (use_addrelu) are XLA fusions.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from apex_tpu.parallel.sync_batchnorm import SyncBatchNorm, sync_batch_norm
+
+
+class BatchNorm2d_NHWC(SyncBatchNorm):
+    """≡ BatchNorm2d_NHWC (groupbn/batch_norm.py:7-101).
+
+    fuse_relu / use_addrelu replicate the fused epilogues; bn_group>1
+    requires `axis_name` naming the mesh sub-axis of the group.
+    """
+
+    def __init__(self, num_features, fuse_relu: bool = False,
+                 bn_group: int = 1, axis_name: Optional[str] = None,
+                 **kw):
+        if bn_group > 1 and axis_name is None:
+            raise ValueError(
+                "bn_group > 1 needs a mesh sub-axis: build the mesh with "
+                "the dp axis factored as (dp_outer, bn) and pass "
+                "axis_name='bn'")
+        super().__init__(num_features, axis_name=axis_name, **kw)
+        self.fuse_relu = fuse_relu
+
+    def apply(self, params, state, x, training=True, z=None,
+              axis_name="__unset__"):
+        ax = self.axis_name if axis_name == "__unset__" else axis_name
+        y, rm, rv = sync_batch_norm(
+            x, params.get("scale"), params.get("bias"),
+            state["running_mean"], state["running_var"],
+            training=training, momentum=self.momentum, eps=self.eps,
+            axis_name=ax, channel_axis=self.channel_axis)
+        if z is not None:  # use_addrelu: residual add before relu
+            y = y + z
+        if self.fuse_relu or z is not None:
+            y = jnp.maximum(y, 0)
+        return y, {"running_mean": rm, "running_var": rv}
+
+
+GroupBatchNorm2d = BatchNorm2d_NHWC  # ≡ apex.contrib.cudnn_gbn

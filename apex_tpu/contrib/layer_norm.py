@@ -1,0 +1,7 @@
+"""≡ apex.contrib.layer_norm.FastLayerNorm
+(apex/contrib/layer_norm/layer_norm.py:40; fast_layer_norm kernels
+tuned per hidden size 768-12288): on TPU the single blocked Pallas
+LayerNorm covers all hidden sizes — this is a re-export."""
+
+from apex_tpu.ops.layer_norm import FusedLayerNorm as FastLayerNorm  # noqa: F401
+from apex_tpu.ops.layer_norm import fused_layer_norm  # noqa: F401

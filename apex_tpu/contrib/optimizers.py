@@ -1,0 +1,11 @@
+"""≡ apex.contrib.optimizers — the distributed (ZeRO) optimizers plus
+the deprecated contrib Fused* aliases (apex/contrib/optimizers/__init__.py)."""
+
+from apex_tpu.optimizers.distributed_fused_adam import (  # noqa: F401
+    DistributedFusedAdam,
+    DistributedFusedLAMB,
+)
+from apex_tpu.optimizers.fused_adam import FusedAdam  # noqa: F401
+from apex_tpu.optimizers.fused_lamb import FusedLAMB  # noqa: F401
+from apex_tpu.optimizers.fused_sgd import FusedSGD  # noqa: F401
+from apex_tpu.amp.fp16_optimizer import FP16_Optimizer  # noqa: F401

@@ -1,0 +1,49 @@
+"""Peer-memory halo exchange facade.
+
+≡ apex.contrib.peer_memory (apex/contrib/peer_memory/peer_memory.py:5
+PeerMemoryPool over raw cudaMalloc'd IPC buffers;
+peer_halo_exchanger_1d.py:5 PeerHaloExchanger1d;
+csrc/peer_memory/peer_memory_cuda.cu:741): NVLink peer-to-peer halo
+transport.  On TPU there is no user-managed peer memory — ICI transfers
+are `lax.ppermute` and XLA owns buffers — so the pool is a documented
+no-op and the halo exchanger maps to collectives.halo_exchange_1d.
+"""
+
+from __future__ import annotations
+
+from apex_tpu.parallel.collectives import halo_exchange_1d, ring_exchange
+
+
+class PeerMemoryPool:
+    """≡ PeerMemoryPool (peer_memory.py:5-60): allocation pooling is
+    XLA's job on TPU; kept for API parity (all methods are no-ops that
+    return None or raise on CUDA-specific raw-pointer paths)."""
+
+    def __init__(self, static_size: int = 0, dynamic_size: int = 0,
+                 peer_ranks=None):
+        self.peer_ranks = peer_ranks
+
+    def allocate_peer_tensors(self, shape, dtype, channels_last, dynamic):
+        raise NotImplementedError(
+            "raw peer-memory tensors are a CUDA/NVLink concept; on TPU "
+            "use lax.ppermute (see PeerHaloExchanger1d)")
+
+    def reset(self):
+        pass
+
+
+class PeerHaloExchanger1d:
+    """≡ PeerHaloExchanger1d (peer_halo_exchanger_1d.py:5): 1-D halo
+    exchange along a sharded spatial dim, over the ICI ring."""
+
+    def __init__(self, ranks=None, rank_in_group=None, peer_pool=None,
+                 half_halo: int = 1, axis_name: str = "dp"):
+        self.half_halo = half_halo
+        self.axis_name = axis_name
+
+    def __call__(self, y, H_split: bool = True, explicit_nhwc: bool = True,
+                 numSM: int = 0, diagnostics: bool = False):
+        dim = 1 if H_split else 2
+        left, right = halo_exchange_1d(y, self.axis_name, self.half_halo,
+                                       dim=dim)
+        return left, right

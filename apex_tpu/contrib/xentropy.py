@@ -1,0 +1,7 @@
+"""≡ apex.contrib.xentropy (apex/contrib/xentropy/__init__.py:1) —
+re-export of the fused label-smoothed softmax cross entropy."""
+
+from apex_tpu.ops.xentropy import (  # noqa: F401
+    SoftmaxCrossEntropyLoss,
+    softmax_cross_entropy_loss,
+)

@@ -1,0 +1,126 @@
+"""Spatial-parallel conv + groupbn + peer halo + misc contrib facades.
+≡ apex/contrib/test/{bottleneck,peer_memory,conv_bias_relu} tests."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu.contrib.bottleneck import spatial_conv2d
+from apex_tpu.contrib.conv_bias_relu import conv_bias_relu
+from apex_tpu.contrib.fmha import FMHA
+from apex_tpu.contrib.groupbn import BatchNorm2d_NHWC
+from apex_tpu.contrib.peer_memory import PeerHaloExchanger1d
+from apex_tpu.models.resnet import conv2d
+from apex_tpu.parallel import mesh as M
+
+
+def test_spatial_conv_matches_dense():
+    """H-sharded 3x3 conv with halo exchange == unsharded SAME conv
+    (≡ test_peer_halo_exchange_module.py / SpatialBottleneck parity)."""
+    mesh = M.initialize_model_parallel()  # dp=8
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 32, 8, 3))
+    w = jax.random.normal(jax.random.PRNGKey(1), (3, 3, 3, 5)) * 0.2
+
+    f = shard_map(
+        lambda xl, w: spatial_conv2d(xl, w, "dp"),
+        mesh=mesh, in_specs=(P(None, "dp"), P()),
+        out_specs=P(None, "dp"), check_vma=False)
+    got = f(x, w)
+    want = conv2d(x, w, padding="SAME")
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_spatial_conv_grads():
+    mesh = M.initialize_model_parallel()
+    x = jax.random.normal(jax.random.PRNGKey(2), (1, 16, 4, 2))
+    w = jax.random.normal(jax.random.PRNGKey(3), (3, 3, 2, 2)) * 0.3
+
+    def local_grads(xl, w):
+        def loss(xl, w):
+            return jnp.sum(spatial_conv2d(xl, w, "dp") ** 2)
+        return jax.grad(loss, argnums=(0, 1))(xl, w)
+
+    gx, gw = shard_map(local_grads, mesh=mesh,
+                       in_specs=(P(None, "dp"), P()),
+                       out_specs=(P(None, "dp"), P()),
+                       check_vma=False)(x, w)
+    rx, rw = jax.grad(
+        lambda xl, w: jnp.sum(conv2d(xl, w, padding="SAME") ** 2),
+        argnums=(0, 1))(x, w)
+    np.testing.assert_allclose(np.asarray(gx), np.asarray(rx), rtol=1e-4,
+                               atol=1e-4)
+    # w grad partial per rank; psum'd by the custom_vjp? No — w is
+    # replicated input and each rank computed its H slice: the global
+    # grad is the SUM over ranks; out_specs P() takes rank 0's partial.
+    # Compare the summed version instead:
+    def local_grads_sum(xl, w):
+        def loss(xl, w):
+            return jnp.sum(spatial_conv2d(xl, w, "dp") ** 2)
+        gx, gw = jax.grad(loss, argnums=(0, 1))(xl, w)
+        return gx, jax.lax.psum(gw, "dp")
+
+    _, gw2 = shard_map(local_grads_sum, mesh=mesh,
+                       in_specs=(P(None, "dp"), P()),
+                       out_specs=(P(None, "dp"), P()),
+                       check_vma=False)(x, w)
+    np.testing.assert_allclose(np.asarray(gw2), np.asarray(rw), rtol=1e-4,
+                               atol=1e-3)
+
+
+def test_groupbn_subgroup():
+    """bn_group=4 over a factored mesh: stats merge within each group of
+    4 only (≡ groupbn IPC subgroups / syncbn process_group tests)."""
+    import numpy as onp
+    from jax.sharding import Mesh
+    devs = onp.asarray(jax.devices()[:8]).reshape(2, 4)
+    mesh = Mesh(devs, ("dpo", "bn"))
+    x = jax.random.normal(jax.random.PRNGKey(4), (16, 2, 2, 6))
+    bn = BatchNorm2d_NHWC(6, axis_name="bn", bn_group=4)
+    params, state = bn.init()
+
+    def local(xl):
+        y, _ = bn.apply(params, state, xl, training=True)
+        return y
+
+    f = shard_map(local, mesh=mesh, in_specs=P(("dpo", "bn")),
+                  out_specs=P(("dpo", "bn")), check_vma=False)
+    got = np.asarray(f(x))
+    # reference: normalize each half (8 samples) independently
+    for half in range(2):
+        xs = np.asarray(x[half * 8:(half + 1) * 8])
+        mean = xs.mean(axis=(0, 1, 2))
+        var = xs.var(axis=(0, 1, 2))
+        want = (xs - mean) / np.sqrt(var + 1e-5)
+        np.testing.assert_allclose(got[half * 8:(half + 1) * 8], want,
+                                   rtol=1e-3, atol=1e-3)
+
+
+def test_peer_halo_exchanger():
+    mesh = M.initialize_model_parallel()
+    y = jnp.arange(64.0).reshape(1, 64, 1, 1)
+    ex = PeerHaloExchanger1d(half_halo=1, axis_name="dp")
+
+    f = shard_map(lambda yl: ex(yl)[0], mesh=mesh,
+                  in_specs=P(None, "dp"), out_specs=P(None, "dp"),
+                  check_vma=False)
+    left = np.asarray(f(y)).ravel()
+    # rank r receives prev rank's last row: y[8r-1 mod 64]
+    expect = [(8 * r - 1) % 64 for r in range(8)]
+    np.testing.assert_allclose(left, expect)
+
+
+def test_conv_bias_relu_and_fmha():
+    x = jax.random.normal(jax.random.PRNGKey(5), (2, 8, 8, 3))
+    w = jax.random.normal(jax.random.PRNGKey(6), (3, 3, 3, 4)) * 0.2
+    b = jnp.linspace(-1, 1, 4)
+    y = conv_bias_relu(x, w, b)
+    want = np.maximum(np.asarray(conv2d(x, w)) + np.asarray(b), 0)
+    np.testing.assert_allclose(np.asarray(y), want, rtol=1e-5, atol=1e-5)
+    assert (np.asarray(y) >= 0).all()
+
+    qkv = jax.random.normal(jax.random.PRNGKey(7), (2, 32, 3, 4, 16))
+    out = FMHA(causal=True)(qkv)
+    assert out.shape == (2, 32, 4, 16)
