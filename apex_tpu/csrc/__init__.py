@@ -1,0 +1,142 @@
+"""ctypes binding for the native host runtime.
+
+≡ the reference's pybind11 extension loading (`import apex_C` etc.) —
+here a plain ctypes binding with automatic build-on-first-use and pure
+Python fallbacks, so the package works with or without a toolchain.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Optional
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SO = os.path.join(_DIR, "libapex_tpu_host.so")
+_LIB = None
+_TRIED = False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _LIB, _TRIED
+    if _LIB is not None or _TRIED:
+        return _LIB
+    _TRIED = True
+    if not os.path.exists(_SO):
+        try:
+            subprocess.run(["sh", os.path.join(_DIR, "build_host_runtime.sh")],
+                           check=True, capture_output=True, timeout=120)
+        except Exception:
+            return None
+    try:
+        lib = ctypes.CDLL(_SO)
+    except OSError:
+        return None
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    lib.flat_layout.restype = ctypes.c_int64
+    lib.flat_layout.argtypes = [i64p, ctypes.c_int64, ctypes.c_int64, i64p]
+    lib.chunk_plan.restype = ctypes.c_int64
+    lib.chunk_plan.argtypes = [i64p, ctypes.c_int64, ctypes.c_int64, i64p,
+                               ctypes.c_int64]
+    lib.shuffle_indices.restype = None
+    lib.shuffle_indices.argtypes = [ctypes.c_int64, ctypes.c_uint64, i64p]
+    lib.gather_rows_f32.restype = None
+    lib.gather_rows_f32.argtypes = [
+        ctypes.POINTER(ctypes.c_float), ctypes.c_int64, i64p,
+        ctypes.c_int64, ctypes.POINTER(ctypes.c_float), ctypes.c_int64]
+    lib.gather_rows_i32.restype = None
+    lib.gather_rows_i32.argtypes = [
+        ctypes.POINTER(ctypes.c_int32), ctypes.c_int64, i64p,
+        ctypes.c_int64, ctypes.POINTER(ctypes.c_int32), ctypes.c_int64]
+    _LIB = lib
+    return _LIB
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def flat_layout(sizes, align: int = 1):
+    """(offsets, padded_total) — aligned flat-buffer layout.
+    ≡ apex_C.flatten's layout math."""
+    sizes = np.ascontiguousarray(sizes, np.int64)
+    lib = _load()
+    if lib is None:  # pure fallback
+        offsets = []
+        off = 0
+        for s in sizes:
+            offsets.append(off)
+            ps = -(-int(s) // align) * align if align > 1 else int(s)
+            off += ps
+        return np.asarray(offsets, np.int64), off
+    out = np.empty(len(sizes), np.int64)
+    total = lib.flat_layout(
+        sizes.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), len(sizes),
+        align, out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
+    return out, int(total)
+
+
+def chunk_plan(sizes, chunk_size: int):
+    """(tensor_idx, offset, len) work items ≡ multi_tensor_apply chunk
+    metadata (csrc/multi_tensor_apply.cuh:19-60)."""
+    sizes = np.ascontiguousarray(sizes, np.int64)
+    max_items = int(sum(-(-int(s) // chunk_size) for s in sizes)) + 1
+    lib = _load()
+    if lib is None:
+        items = []
+        for i, s in enumerate(sizes):
+            off = 0
+            s = int(s)
+            while s > 0:
+                l = min(chunk_size, s)
+                items.append((i, off, l))
+                off += l
+                s -= l
+        return np.asarray(items, np.int64).reshape(-1, 3)
+    out = np.empty((max_items, 3), np.int64)
+    n = lib.chunk_plan(
+        sizes.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), len(sizes),
+        chunk_size, out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        max_items)
+    assert n >= 0
+    return out[:n]
+
+
+def shuffle_indices(n: int, seed: int):
+    """Deterministic Fisher-Yates permutation of [0, n)."""
+    lib = _load()
+    if lib is None:
+        rng = np.random.RandomState(seed & 0x7FFFFFFF)
+        return rng.permutation(n).astype(np.int64)
+    out = np.empty(n, np.int64)
+    lib.shuffle_indices(n, seed,
+                        out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
+    return out
+
+
+def gather_rows(dataset: np.ndarray, indices, num_threads: int = 4):
+    """batch[b] = dataset[indices[b]] — threaded host gather (the data
+    loader hot path)."""
+    indices = np.ascontiguousarray(indices, np.int64)
+    dataset = np.ascontiguousarray(dataset)
+    lib = _load()
+    if lib is None or dataset.dtype not in (np.float32, np.int32):
+        return dataset[indices]
+    out = np.empty((len(indices),) + dataset.shape[1:], dataset.dtype)
+    row_len = int(np.prod(dataset.shape[1:]))
+    if dataset.dtype == np.float32:
+        lib.gather_rows_f32(
+            dataset.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), row_len,
+            indices.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            len(indices),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), num_threads)
+    else:
+        lib.gather_rows_i32(
+            dataset.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), row_len,
+            indices.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            len(indices),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), num_threads)
+    return out

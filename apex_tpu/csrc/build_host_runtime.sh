@@ -1,0 +1,9 @@
+#!/bin/sh
+# Build the native host-runtime shared library (no torch, no pybind —
+# plain g++ + ctypes binding).  ≡ the reference's setup.py --cpp_ext
+# path (setup.py:115-365) minus CUDA.
+set -e
+cd "$(dirname "$0")"
+g++ -O3 -shared -fPIC -std=c++17 -pthread host_runtime.cpp \
+    -o libapex_tpu_host.so
+echo "built $(pwd)/libapex_tpu_host.so"
