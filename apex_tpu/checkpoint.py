@@ -1,0 +1,80 @@
+"""Checkpoint / resume.
+
+≡ the reference's checkpoint surface (SURVEY §5.4): amp.state_dict
+(apex/amp/frontend.py:365-404 — apex_tpu.amp.state_dict),
+FP16_Optimizer.state_dict (fp16_utils/fp16_optimizer.py —
+amp/fp16_optimizer.py), and model/optimizer persistence which the
+reference leaves to user scripts (examples/imagenet/main_amp.py save
+path).  Here it is first-class: orbax-backed sharded save/restore of
+arbitrary pytrees (params, optimizer flat buffers, scaler state), with
+a numpy fallback when orbax is unavailable.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any, Optional
+
+import jax
+import numpy as np
+
+
+def _to_host(tree):
+    return jax.tree_util.tree_map(lambda x: np.asarray(x), tree)
+
+
+def save_checkpoint(path: str, tree, step: Optional[int] = None,
+                    use_orbax: bool = True) -> str:
+    """Save a pytree; returns the directory written."""
+    path = os.path.abspath(path)
+    if step is not None:
+        path = os.path.join(path, f"step_{step}")
+    os.makedirs(path, exist_ok=True)
+    if use_orbax:
+        try:
+            import orbax.checkpoint as ocp
+            ckpt = ocp.PyTreeCheckpointer()
+            ckpt.save(os.path.join(path, "state"), _to_host(tree),
+                      force=True)
+            return path
+        except Exception:
+            pass
+    with open(os.path.join(path, "state.pkl"), "wb") as f:
+        pickle.dump(_to_host(tree), f)
+    return path
+
+
+def load_checkpoint(path: str, step: Optional[int] = None,
+                    target: Any = None):
+    """Restore a pytree saved by save_checkpoint."""
+    path = os.path.abspath(path)
+    if step is not None:
+        path = os.path.join(path, f"step_{step}")
+    orbax_path = os.path.join(path, "state")
+    if os.path.exists(orbax_path):
+        import orbax.checkpoint as ocp
+        ckpt = ocp.PyTreeCheckpointer()
+        restored = ckpt.restore(orbax_path)
+        if target is not None:
+            restored = jax.tree_util.tree_unflatten(
+                jax.tree_util.tree_structure(target),
+                jax.tree_util.tree_leaves(restored))
+        return restored
+    with open(os.path.join(path, "state.pkl"), "rb") as f:
+        return pickle.load(f)
+
+
+def latest_step(path: str) -> Optional[int]:
+    """Find the newest step_N under path (auto-resume helper ≡ the
+    reference's get_autoresume hook, pipeline_parallel/utils.py:142)."""
+    if not os.path.isdir(path):
+        return None
+    steps = []
+    for d in os.listdir(path):
+        if d.startswith("step_"):
+            try:
+                steps.append(int(d[5:]))
+            except ValueError:
+                pass
+    return max(steps) if steps else None

@@ -140,6 +140,13 @@ def make_train_step(loss_fn: Callable, optimizer, mesh, *,
         params = F.unflatten(opt_state.params, optimizer.spec)
         if policy is not None:
             params = policy.cast_to_param(params)
+            if policy.compute_dtype != jnp.float32:
+                # O1/O2 compute cast: params + floating batch inputs run
+                # in the compute dtype (≡ the patched-op casts of amp O1,
+                # apex/amp/lists/torch_overrides.py); norm/loss-class ops
+                # re-promote to fp32 internally (FP32_CLASS_OPS contract)
+                params = policy.cast_to_compute(params)
+                batch = policy.cast_to_compute(batch)
 
         def scaled_loss_fn(p, b):
             if with_state:

@@ -1,0 +1,83 @@
+"""GPT scaling sweep — iteration time vs model size.
+
+≡ tests/L0/run_transformer/gpt_scaling_test.py:7-112: sweeps hidden
+sizes, runs the standalone GPT, parses/prints "Average Iteration Time",
+and reports s/iter vs parameter count.
+
+  python examples/gpt_scaling_test.py --steps 5 --batch-size 8
+"""
+
+from __future__ import annotations
+
+import os as _os
+import sys as _sys
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
+
+import argparse
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from apex_tpu.models.gpt import GPT, GPTConfig
+from apex_tpu.optimizers.fused_adam import FusedAdam
+from apex_tpu.parallel import mesh as M
+from apex_tpu.transformer.training import (
+    init_sharded_optimizer,
+    make_tp_dp_train_step,
+)
+
+SWEEP = [  # (hidden, layers, heads) ≈ gpt_scaling_test.py size points
+    (512, 8, 8),
+    (1024, 12, 16),
+    (1536, 16, 16),
+    (2048, 24, 32),
+]
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--steps", type=int, default=5)
+    p.add_argument("--batch-size", type=int, default=8)
+    p.add_argument("--seq-len", type=int, default=512)
+    p.add_argument("--tp", type=int, default=1)
+    p.add_argument("--max-hidden", type=int, default=2048)
+    args = p.parse_args()
+
+    for hidden, layers, heads in SWEEP:
+        if hidden > args.max_hidden:
+            continue
+        M.destroy_model_parallel()
+        mesh = M.initialize_model_parallel(
+            tensor_model_parallel_size=args.tp)
+        cfg = GPTConfig(vocab_size=50304, seq_len=args.seq_len,
+                        hidden=hidden, num_layers=layers, num_heads=heads,
+                        dtype=jnp.bfloat16, remat=True,
+                        use_flash_attention=True,
+                        sequence_parallel=args.tp > 1)
+        model = GPT(cfg)
+        params = model.init(jax.random.PRNGKey(0))
+        n_params = sum(x.size for x in jax.tree_util.tree_leaves(params))
+        opt = FusedAdam(lr=1e-4)
+        opt_state = init_sharded_optimizer(opt, model, params, mesh)
+        step = make_tp_dp_train_step(model, opt, mesh, donate=False)
+        tokens = jax.random.randint(
+            jax.random.PRNGKey(1), (args.batch_size, args.seq_len), 0,
+            cfg.vocab_size)
+        labels = jnp.roll(tokens, -1, axis=1)
+        opt_state, loss = step(opt_state, tokens, labels)  # compile
+        _ = np.asarray(loss)
+        t0 = time.perf_counter()
+        for _ in range(args.steps):
+            opt_state, loss = step(opt_state, tokens, labels)
+        _ = np.asarray(loss)
+        dt = (time.perf_counter() - t0) / args.steps
+        # ≡ the parsed metric (gpt_scaling_test.py:13-47)
+        print(f"hidden={hidden} params={n_params/1e6:.0f}M  "
+              f"Average Iteration Time: {dt:.3f} s  "
+              f"({args.batch_size*args.seq_len/dt:.0f} tokens/s)")
+
+
+if __name__ == "__main__":
+    main()

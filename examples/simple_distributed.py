@@ -1,0 +1,45 @@
+"""Minimal data-parallel training ≡ examples/simple/distributed/
+(distributed_data_parallel.py): the smallest DDP-equivalent program.
+
+  python examples/simple_distributed.py
+"""
+import os as _os
+import sys as _sys
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
+
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu.optimizers.fused_sgd import FusedSGD
+from apex_tpu.parallel import ddp
+from apex_tpu.parallel import mesh as M
+
+
+def main():
+    mesh = M.initialize_model_parallel()
+    print("mesh:", dict(mesh.shape))
+
+    X = jax.random.normal(jax.random.PRNGKey(0), (64, 8))
+    w_true = jax.random.normal(jax.random.PRNGKey(1), (8, 1))
+    Y = X @ w_true
+
+    def loss_fn(params, batch):
+        x, y = batch
+        return jnp.mean((x @ params["w"] - y) ** 2)
+
+    opt = FusedSGD(lr=0.2)
+    state = opt.init({"w": jnp.zeros((8, 1))})
+    step = ddp.make_train_step(loss_fn, opt, mesh,
+                               batch_spec=(P("dp"), P("dp")))
+    for i in range(20):
+        state, _, loss = step(state, None, (X, Y))
+        if i % 5 == 0:
+            print(f"step {i}: loss {float(loss):.6f}")
+    print("final loss:", float(loss))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,46 @@
+"""Checkpoint/resume ≡ tests/L0/run_amp/test_checkpointing.py: scaler
+state round-trips, optimizer/model state round-trips, auto-resume."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from apex_tpu import amp
+from apex_tpu.checkpoint import latest_step, load_checkpoint, save_checkpoint
+from apex_tpu.optimizers.fused_adam import FusedAdam
+
+
+def test_amp_state_roundtrip():
+    state = amp.initialize(opt_level="O2")
+    # simulate some scaler evolution
+    s = state.loss_scalers[0]
+    from apex_tpu.amp import scaler as scaler_lib
+    s = scaler_lib.update(s, jnp.asarray(True))   # overflow → halve
+    state.loss_scalers[0] = s
+    d = amp.state_dict(state)
+    assert d["loss_scaler0"]["loss_scale"] == 2.0 ** 15
+    state2 = amp.initialize(opt_level="O2")
+    state2 = amp.load_state_dict(state2, d)
+    assert float(state2.loss_scalers[0].scale) == 2.0 ** 15
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    params = {"w": jax.random.normal(jax.random.PRNGKey(0), (4, 3))}
+    opt = FusedAdam(lr=1e-2)
+    state = opt.init(params)
+    _, state = opt.step(state, {"w": jnp.ones((4, 3))})
+
+    path = save_checkpoint(str(tmp_path / "ckpt"), opt.state_dict(state),
+                           step=1)
+    assert latest_step(str(tmp_path / "ckpt")) == 1
+    restored = load_checkpoint(str(tmp_path / "ckpt"), step=1)
+    state2 = opt.load_state_dict(restored)
+    np.testing.assert_allclose(np.asarray(state2.params),
+                               np.asarray(state.params), rtol=1e-6)
+    assert int(state2.step) == 1
+
+    # training continues identically from the restored state
+    p1, _ = opt.step(state, {"w": jnp.ones((4, 3))})
+    p2, _ = opt.step(state2, {"w": jnp.ones((4, 3))})
+    np.testing.assert_allclose(np.asarray(p1["w"]), np.asarray(p2["w"]),
+                               rtol=1e-6)
