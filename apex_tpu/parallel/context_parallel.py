@@ -1,0 +1,111 @@
+"""Context parallelism for long sequences — ring attention + Ulysses.
+
+The reference's only long-sequence mechanism is Megatron SP + fixed-size
+FMHA kernels (SURVEY §5.7: no ring attention, no Ulysses).  For the TPU
+framework long context is first-class: the flash kernel's blockwise
+structure extends across chips —
+
+* `ring_attention` — sequence (and KV) sharded over a mesh axis; KV
+  chunks rotate around the ICI ring with `ppermute` while each device
+  accumulates its queries' online-softmax state (running max / denom /
+  output).  Peak memory per device: O(s_local²) scores, O(s_local·d)
+  KV — sequence length scales linearly with the ring size.
+
+* `ulysses_attention` — all-to-all head scatter: convert seq-sharding
+  to head-sharding with `lax.all_to_all`, run dense (flash) attention
+  on full sequences of the local heads, convert back.  One collective
+  pair per attention instead of n ring hops; needs heads % axis == 0.
+
+Both are differentiable (AD through scan/ppermute/all_to_all emits the
+reverse rotation) and compose with the TP layers (use a separate mesh
+axis or reuse "tp" when attention is not head-sharded).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def ring_attention(q, k, v, axis_name: str, *, causal: bool = False,
+                   softmax_scale: Optional[float] = None):
+    """Blockwise ring attention.
+
+    q, k, v: (b, h, s_local, d) — the LOCAL sequence shard; the global
+    sequence is the concatenation over the axis in rank order.
+    Returns the local output shard (b, h, s_local, d).
+    """
+    b, h, s_local, d = q.shape
+    n = lax.axis_size(axis_name)
+    rank = lax.axis_index(axis_name)
+    scale = softmax_scale if softmax_scale is not None else 1.0 / math.sqrt(d)
+
+    q32 = q.astype(jnp.float32)
+    q_pos = rank * s_local + jnp.arange(s_local)          # global q rows
+
+    def step(carry, i):
+        m, l, o, kv = carry
+        k_i, v_i = kv
+        src = (rank - i) % n                              # chunk origin
+        s = jnp.einsum("bhqd,bhkd->bhqk", q32,
+                       k_i.astype(jnp.float32)) * scale
+        if causal:
+            kv_pos = src * s_local + jnp.arange(s_local)
+            mask = kv_pos[None, :] > q_pos[:, None]       # (s_local, s_local)
+            s = jnp.where(mask[None, None], -1e30, s)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        o_new = o * alpha + jnp.einsum("bhqk,bhkd->bhqd", p,
+                                       v_i.astype(jnp.float32))
+        # rotate KV to the next rank (ICI neighbour exchange)
+        perm = [(r, (r + 1) % n) for r in range(n)]
+        kv_next = jax.tree_util.tree_map(
+            lambda x: lax.ppermute(x, axis_name, perm), (k_i, v_i))
+        return (m_new, l_new, o_new, kv_next), None
+
+    m0 = jnp.full((b, h, s_local, 1), -1e30, jnp.float32)
+    l0 = jnp.zeros((b, h, s_local, 1), jnp.float32)
+    o0 = jnp.zeros((b, h, s_local, d), jnp.float32)
+    (m, l, o, _), _ = lax.scan(step, (m0, l0, o0, (k, v)), jnp.arange(n))
+    return (o / jnp.maximum(l, 1e-30)).astype(q.dtype)
+
+
+def ulysses_attention(q, k, v, axis_name: str, *, causal: bool = False,
+                      softmax_scale: Optional[float] = None,
+                      use_flash: bool = True):
+    """All-to-all (DeepSpeed-Ulysses-style) sequence parallelism.
+
+    Inputs are seq-sharded (b, h, s_local, d) with h % axis_size == 0;
+    internally heads are scattered so each device sees the FULL sequence
+    for h/axis heads, runs (flash) attention, and scatters back.
+    """
+    n = lax.axis_size(axis_name)
+    b, h, s_local, d = q.shape
+    assert h % n == 0, "ulysses needs heads divisible by the axis size"
+
+    def seq_to_heads(x):
+        # (b, h, s_local, d) → (b, h/n, s_global, d): scatter heads,
+        # gather sequence — one tiled all_to_all
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+    def heads_to_seq(x):
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    qg, kg, vg = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+    if use_flash:
+        from apex_tpu.ops.flash_attention import flash_attention
+        og = flash_attention(qg, kg, vg, causal=causal,
+                             softmax_scale=softmax_scale)
+    else:
+        from apex_tpu.ops.flash_attention import attention_reference
+        og = attention_reference(qg, kg, vg, causal=causal,
+                                 softmax_scale=softmax_scale)
+    return heads_to_seq(og)

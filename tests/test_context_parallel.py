@@ -1,0 +1,81 @@
+"""Ring attention / Ulysses vs dense attention — the long-context CP
+layer (beyond reference parity; SURVEY §2.4 CP note)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu.ops.flash_attention import attention_reference
+from apex_tpu.parallel import mesh as M
+from apex_tpu.parallel.context_parallel import (
+    ring_attention,
+    ulysses_attention,
+)
+
+N = 8
+
+
+def _qkv(b, h, s, d, seed=0):
+    ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+    return (jax.random.normal(ks[0], (b, h, s, d)),
+            jax.random.normal(ks[1], (b, h, s, d)),
+            jax.random.normal(ks[2], (b, h, s, d)))
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_attention_matches_dense(causal):
+    mesh = M.initialize_model_parallel(tensor_model_parallel_size=N)
+    q, k, v = _qkv(1, 2, 64, 16)
+
+    f = shard_map(
+        lambda q, k, v: ring_attention(q, k, v, "tp", causal=causal),
+        mesh=mesh,
+        in_specs=(P(None, None, "tp"), P(None, None, "tp"),
+                  P(None, None, "tp")),
+        out_specs=P(None, None, "tp"), check_vma=False)
+    got = f(q, k, v)
+    want = attention_reference(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_ring_attention_grads():
+    mesh = M.initialize_model_parallel(tensor_model_parallel_size=N)
+    q, k, v = _qkv(1, 1, 32, 8, seed=1)
+
+    def local_grads(q, k, v):
+        def loss(q, k, v):
+            o = ring_attention(q, k, v, "tp", causal=True)
+            return jnp.sum(o ** 2)
+        return jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+
+    spec = P(None, None, "tp")
+    g = shard_map(local_grads, mesh=mesh, in_specs=(spec, spec, spec),
+                  out_specs=(spec, spec, spec), check_vma=False)(q, k, v)
+    r = jax.grad(
+        lambda q, k, v: jnp.sum(attention_reference(
+            q, k, v, causal=True) ** 2), argnums=(0, 1, 2))(q, k, v)
+    for a, e, n in zip(g, r, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(e),
+                                   rtol=1e-3, atol=1e-3,
+                                   err_msg=f"d{n}")
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ulysses_matches_dense(causal):
+    mesh = M.initialize_model_parallel(tensor_model_parallel_size=N)
+    q, k, v = _qkv(2, 8, 64, 16, seed=2)  # h=8 divisible by N
+
+    f = shard_map(
+        lambda q, k, v: ulysses_attention(q, k, v, "tp", causal=causal,
+                                          use_flash=False),
+        mesh=mesh,
+        in_specs=(P(None, None, "tp"),) * 3,
+        out_specs=P(None, None, "tp"), check_vma=False)
+    got = f(q, k, v)
+    want = attention_reference(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-4, atol=1e-4)
