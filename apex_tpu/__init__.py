@@ -45,6 +45,21 @@ _logger = _logging.getLogger(__name__)
 _logger.addHandler(_logging.NullHandler())
 
 
+def deprecated_warning(msg: str) -> None:
+    """≡ apex.deprecated_warning (apex/__init__.py:45-56): emit a
+    deprecation notice once, only from process 0."""
+    import warnings
+
+    try:
+        import jax
+
+        if jax.process_index() != 0:
+            return
+    except Exception:
+        pass
+    warnings.warn(msg, FutureWarning, stacklevel=2)
+
+
 def _get_logger(name=None):
     return _logging.getLogger(name or __name__)
 

@@ -1,0 +1,96 @@
+"""apex_tpu.fp16_utils — manual fp16/bf16 master-weight tooling.
+
+≡ apex.fp16_utils (apex/fp16_utils/__init__.py): the older, explicit
+mixed-precision workflow — convert a network to half keeping norms fp32,
+keep fp32 master params, copy grads/params between the two, wrap the
+optimizer, scale losses.  In apex_tpu the mechanisms live in
+`apex_tpu.amp` (pure-functional policies and scaler states); this module
+re-exports them under the reference names so reference users find the
+same surface:
+
+  network_to_half / convert_network  ≡ fp16util.py:35-72
+  prep_param_lists                   ≡ fp16util.py:92
+  model_grads_to_master_grads        ≡ fp16util.py:138
+  master_params_to_model_params      ≡ fp16util.py:160
+  FP16_Optimizer                     ≡ fp16_optimizer.py:13
+  LossScaler / DynamicLossScaler     ≡ loss_scaler.py:10,49
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from apex_tpu.amp import scaler as _scaler
+from apex_tpu.amp.fp16_optimizer import FP16_Optimizer
+from apex_tpu.amp.policy import (
+    convert_network,
+    master_params_to_model_params,
+    model_grads_to_master_grads,
+    prep_param_lists,
+)
+
+__all__ = [
+    "network_to_half", "convert_network", "prep_param_lists",
+    "model_grads_to_master_grads", "master_params_to_model_params",
+    "FP16_Optimizer", "LossScaler", "DynamicLossScaler", "to_python_float",
+]
+
+
+def network_to_half(params, dtype=jnp.float16):
+    """≡ network_to_half (apex/fp16_utils/fp16util.py:35-44): cast every
+    floating leaf to half, keeping norm/BN params fp32 (the reference
+    wraps BN modules in `tofp16`-exempt shells; here norm leaves are
+    recognized by name in convert_network)."""
+    return convert_network(params, dtype)
+
+
+def to_python_float(x):
+    """≡ to_python_float (apex/fp16_utils/fp16util.py): host scalar."""
+    try:
+        return float(x)
+    except TypeError:
+        return float(jnp.asarray(x).reshape(()))
+
+
+class LossScaler:
+    """Static loss scaler ≡ apex/fp16_utils/loss_scaler.py:10-46, as a
+    thin OO facade over the functional apex_tpu.amp.scaler state."""
+
+    dynamic = False
+
+    def __init__(self, scale=1.0):
+        self.state = _scaler.init(float(scale))
+
+    @property
+    def loss_scale(self):
+        return float(self.state.scale)
+
+    def scale_loss(self, loss):
+        return _scaler.scale_loss(self.state, loss)
+
+    def unscale(self, grads):
+        return _scaler.unscale(self.state, grads)
+
+    def update_scale(self, overflow):
+        self.state = _scaler.update(self.state, overflow,
+                                    dynamic=self.dynamic)
+
+
+class DynamicLossScaler(LossScaler):
+    """≡ apex/fp16_utils/loss_scaler.py:49-118: grow scale on a run of
+    finite steps, halve on overflow."""
+
+    dynamic = True
+
+    def __init__(self, init_scale=2.0 ** 32, scale_factor=2.0,
+                 scale_window=1000):
+        self.state = _scaler.init("dynamic", init_scale=float(init_scale))
+        self.scale_factor = scale_factor
+        self.scale_window = scale_window
+
+    def update_scale(self, overflow):
+        self.state = _scaler.update(
+            self.state, overflow, dynamic=True,
+            growth_interval=self.scale_window,
+            growth_factor=self.scale_factor,
+            backoff_factor=1.0 / self.scale_factor)

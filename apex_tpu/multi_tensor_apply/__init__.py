@@ -1,0 +1,82 @@
+"""apex_tpu.multi_tensor_apply — chunked multi-tensor functor dispatch.
+
+≡ apex.multi_tensor_apply (apex/multi_tensor_apply/multi_tensor_apply.py:3-30)
+and the native chunking template it dispatches to
+(csrc/multi_tensor_apply.cuh:19-100).
+
+On TPU the launch-granularity problem the reference solves (hundreds of
+small tensors -> a handful of CUDA kernel launches, <=110 tensors / 320
+blocks per launch) does not exist: XLA compiles the whole update into one
+program.  What remains useful is the *interface* — "apply this functor to
+parallel lists of tensors in one fused pass" — which we express by
+flattening each tensor list into a single 1-D buffer
+(apex_tpu.optimizers.flat), applying the functor once, and scattering the
+results back.  The C++ host runtime (apex_tpu/csrc) supplies the same
+chunk-planning arithmetic for the native data path.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.optimizers import flat as _flat
+
+__all__ = ["MultiTensorApply", "multi_tensor_applier"]
+
+
+class MultiTensorApply:
+    """Callable dispatcher ≡ MultiTensorApply
+    (apex/multi_tensor_apply/multi_tensor_apply.py:24-30).
+
+    `chunk_size` is kept for signature parity; it has no performance
+    meaning under XLA (there is exactly one fused "launch") but is used
+    by the C++ host planner when staging buffers
+    (apex_tpu/csrc/__init__.py:82 chunk_plan).
+    """
+
+    available = True
+
+    def __init__(self, chunk_size: int = 2048 * 32):
+        self.chunk_size = int(chunk_size)
+
+    def __call__(self, op: Callable, noop_flag,
+                 tensor_lists: Sequence[Sequence[jax.Array]], *args):
+        """Apply `op` elementwise across parallel tensor lists.
+
+        op(noop_flag, flat_buffers, *args) -> tuple of updated flat
+        buffers (one per input list) — mirroring the reference call
+        `multi_tensor_applier(op, overflow_buf, [g, p, m, v], ...)`
+        (apex/optimizers/fused_adam.py:265-303).  Returns the updated
+        tensor lists (functional: no in-place mutation in JAX).
+        """
+        if not tensor_lists or not tensor_lists[0]:
+            return tuple(list(tl) for tl in tensor_lists)
+        n = len(tensor_lists[0])
+        for tl in tensor_lists:
+            if len(tl) != n:
+                raise ValueError("tensor lists must have equal length "
+                                 "(≡ multi_tensor_apply.cuh size check)")
+        for tl in tensor_lists:
+            if any(t.dtype != tl[0].dtype for t in tl):
+                raise ValueError(
+                    "all tensors in one list must share a dtype "
+                    "(≡ multi_tensor_apply.cuh per-list dtype assert)")
+        specs = [_flat.make_spec(list(tl)) for tl in tensor_lists]
+        flats = [_flat.flatten(list(tl), dtype=tl[0].dtype)
+                 for tl in tensor_lists]
+        outs = op(noop_flag, flats, *args)
+        if isinstance(outs, jax.Array):
+            outs = (outs,)
+        rebuilt = []
+        for out, spec, tl in zip(outs, specs, tensor_lists):
+            if out is None:
+                rebuilt.append(list(tl))
+            else:
+                rebuilt.append(_flat.unflatten(out, spec))
+        return tuple(rebuilt)
+
+
+multi_tensor_applier = MultiTensorApply(2048 * 32)

@@ -38,6 +38,13 @@ def sync_gradients(grads, axis_name: str = DP_AXIS, average: bool = True):
 
     ≡ DDP's bucketed allreduce with gradient_average=True
     (apex/parallel/distributed.py:449-458).  Inside pjit/shard_map only.
+
+    Call under `shard_map(..., check_vma=False)` (the make_train_step
+    convention).  Under JAX's default varying-manual-axes tracking,
+    differentiating w.r.t. replicated params already inserts a psum
+    (the transpose of pvary), so grads arrive pre-summed and a further
+    pmean would silently keep the SUM — either disable vma tracking or
+    don't re-sync auto-summed grads.
     """
     op = jax.lax.pmean if average else jax.lax.psum
     return jax.tree_util.tree_map(lambda g: op(g, axis_name), grads)

@@ -1,0 +1,100 @@
+"""Multi-process launcher for multi-host (multi-controller) JAX runs.
+
+≡ apex.parallel.multiproc (apex/parallel/multiproc.py): the reference
+spawns `nproc_per_node` copies of a training script with RANK/WORLD_SIZE
+env vars for `torch.distributed`.  The TPU-native analogue launches N
+controller processes wired to a `jax.distributed` coordinator; on CPU it
+additionally forces the emulated-device platform so sharding code paths
+run without TPU hardware (the harness gap called out in SURVEY.md §4).
+
+Usage:
+    python -m apex_tpu.parallel.multiproc --nproc 4 train.py --arg ...
+
+Each child gets:
+    APEX_TPU_COORDINATOR   host:port of the jax.distributed coordinator
+    APEX_TPU_NUM_PROCESSES total process count
+    APEX_TPU_PROCESS_ID    this process's id
+and (CPU emulation) JAX_PLATFORMS=cpu plus
+--xla_force_host_platform_device_count so every process sees
+`devices_per_proc` local devices.  `init_from_env()` is the child-side
+hook that calls `jax.distributed.initialize` from those variables.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+
+__all__ = ["main", "init_from_env"]
+
+
+def init_from_env():
+    """Child-side: initialize jax.distributed from launcher env vars.
+
+    ≡ the `torch.distributed.init_process_group(init_method='env://')`
+    call the reference's spawned scripts perform.  No-op when the
+    launcher variables are absent (single-process run).
+    """
+    coord = os.environ.get("APEX_TPU_COORDINATOR")
+    if not coord:
+        return False
+    import jax
+
+    devs = int(os.environ.get("APEX_TPU_DEVICES_PER_PROC", "0"))
+    if devs > 0:
+        # CPU emulation must be forced through jax.config: plugin
+        # platforms (e.g. a TPU tunnel) can take priority over the
+        # JAX_PLATFORMS env var set by the launcher.
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_num_cpu_devices", devs)
+    jax.distributed.initialize(
+        coordinator_address=coord,
+        num_processes=int(os.environ["APEX_TPU_NUM_PROCESSES"]),
+        process_id=int(os.environ["APEX_TPU_PROCESS_ID"]),
+    )
+    return True
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        description="apex_tpu multi-process launcher "
+                    "(≡ apex/parallel/multiproc.py)")
+    parser.add_argument("--nproc", type=int, default=2,
+                        help="number of controller processes to spawn")
+    parser.add_argument("--coordinator", default="127.0.0.1:12355",
+                        help="jax.distributed coordinator host:port")
+    parser.add_argument("--devices-per-proc", type=int, default=0,
+                        help=">0: force CPU emulation with this many "
+                             "virtual devices per process")
+    parser.add_argument("script", help="training script to run")
+    parser.add_argument("script_args", nargs=argparse.REMAINDER)
+    args = parser.parse_args(argv)
+
+    procs = []
+    for pid in range(args.nproc):
+        env = dict(os.environ)
+        env["APEX_TPU_COORDINATOR"] = args.coordinator
+        env["APEX_TPU_NUM_PROCESSES"] = str(args.nproc)
+        env["APEX_TPU_PROCESS_ID"] = str(pid)
+        if args.devices_per_proc > 0:
+            env["APEX_TPU_DEVICES_PER_PROC"] = str(args.devices_per_proc)
+            env["JAX_PLATFORMS"] = "cpu"
+            flags = env.get("XLA_FLAGS", "")
+            env["XLA_FLAGS"] = (
+                f"{flags} --xla_force_host_platform_device_count="
+                f"{args.devices_per_proc}").strip()
+        cmd = [sys.executable, args.script] + args.script_args
+        procs.append(subprocess.Popen(cmd, env=env))
+
+    rc = 0
+    for p in procs:
+        p.wait()
+        rc = rc or p.returncode
+    # Mirror the reference's behavior of surfacing a child failure.
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,31 @@
+"""Collective-backend availability probe.
+
+≡ apex.transformer._ucc_util (apex/transformer/_ucc_util.py:1-9), which
+exposes HAS_UCC so callers can select the UCC torch.distributed backend.
+JAX has no pluggable collective backend — XLA emits ICI/DCN collectives —
+so the analogous runtime question is "which platforms are live and can a
+multi-process (multi-controller) run be formed".
+"""
+
+from __future__ import annotations
+
+import jax
+
+__all__ = ["HAS_UCC", "backend_available", "default_backend"]
+
+# UCC never applies on TPU; kept for API parity with the reference import
+# sites (`from apex.transformer._ucc_util import HAS_UCC`).
+HAS_UCC = False
+
+
+def backend_available(name: str) -> bool:
+    """True if a JAX platform with this name is available ('tpu',
+    'cpu', 'gpu') — not merely the default one."""
+    try:
+        return bool(jax.devices(name))
+    except RuntimeError:
+        return False
+
+
+def default_backend() -> str:
+    return jax.default_backend()

@@ -7,5 +7,10 @@ from apex_tpu.transformer.pipeline_parallel.schedules import (  # noqa: F401
     get_forward_backward_func,
     spmd_pipeline,
 )
+from apex_tpu.transformer.pipeline_parallel import common  # noqa: F401
 from apex_tpu.transformer.pipeline_parallel import p2p_communication  # noqa: F401
 from apex_tpu.transformer.pipeline_parallel import utils  # noqa: F401
+from apex_tpu.transformer.pipeline_parallel.common import (  # noqa: F401
+    build_model,
+    get_params_for_weight_decay_optimization,
+)

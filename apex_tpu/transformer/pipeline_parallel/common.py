@@ -1,0 +1,159 @@
+"""Schedule-independent pipeline helpers.
+
+≡ apex/transformer/pipeline_parallel/schedules/common.py: model-chunk
+construction with pre/post-process placement (build_model, common.py:30-149),
+the per-microbatch forward/backward steps (253-403), output freeing /
+direct-engine backward (199-250), and the weight-decay param split (162).
+
+In the SPMD pipeline (apex_tpu.transformer.pipeline_parallel.schedules)
+set_input_tensor / p2p handoff is built into the clocked scan, and XLA's
+buffer donation replaces manual output freeing — the helpers here keep
+the reference's call shape for drivers written against it.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.parallel import mesh as _mesh
+
+__all__ = [
+    "build_model", "forward_step", "backward_step", "free_output_tensor",
+    "custom_backward", "get_params_for_weight_decay_optimization",
+]
+
+
+def build_model(model_provider_func: Callable, wrap_with_ddp: bool = True,
+                virtual_pipeline_model_parallel_size: Optional[int] = None,
+                stage: Optional[int] = None, *args, **kwargs) -> List[Any]:
+    """Construct this pipeline stage's model chunk(s).
+
+    ≡ build_model (schedules/common.py:30-149): calls
+    `model_provider_func(*args, pre_process=..., post_process=..., **kwargs)`
+    once per virtual chunk this stage owns; pre_process is True only for
+    the chunk occupying the first pipeline stage (embedding lives there),
+    post_process only for the last (LM head / loss).  The encoder/decoder
+    split-rank variant applies the same placement rule around
+    `pipeline_model_parallel_split_rank`.
+
+    `wrap_with_ddp` has no wrapper object here — data-parallel gradient
+    sync is a `psum` inserted by the train-step builder
+    (apex_tpu/parallel/ddp.py), so the flag only records intent (the
+    reference wraps each chunk in torchDDP, common.py:138-148).
+
+    `stage` is this controller's pipeline stage.  Multi-controller
+    drivers pass it explicitly; under the single-controller SPMD
+    pipeline one process owns every stage (the schedule stacks stage
+    params), so the default builds stage 0's chunks — call once per
+    stage to materialize the whole pipe.
+    """
+    pp = _mesh.get_pipeline_model_parallel_world_size()
+    if stage is None:
+        stage = 0
+    vpp = virtual_pipeline_model_parallel_size
+    if vpp is not None and pp <= 2:
+        # Reference asserts pp > 2 for interleaving (common.py:49-54).
+        raise ValueError(
+            "virtual pipeline parallelism requires pipeline_model_parallel_"
+            "size > 2 (≡ schedules/common.py assertion)")
+    num_chunks = vpp if vpp is not None else 1
+    total_stages = pp * num_chunks
+    models = []
+    for chunk in range(num_chunks):
+        _mesh.set_virtual_pipeline_model_parallel_rank(chunk)
+        # Global position of this (stage, chunk) in the virtual pipeline:
+        # interleaved placement — chunk c of stage s is virtual stage
+        # c * pp + s (fwd_bwd_pipelining_with_interleaving.py:221-260).
+        virtual_stage = chunk * pp + stage
+        pre_process = virtual_stage == 0
+        post_process = virtual_stage == total_stages - 1
+        models.append(model_provider_func(
+            *args, pre_process=pre_process, post_process=post_process,
+            **kwargs))
+    return models
+
+
+def forward_step(forward_step_func: Callable, batch, model,
+                 input_tensor: Optional[jax.Array],
+                 num_microbatches: int = 1):
+    """One microbatch forward ≡ forward_step (schedules/common.py:253-322).
+
+    `forward_step_func(batch, model) -> (output, loss_func)` — the
+    reference contract.  When `input_tensor` is not None this stage is
+    not first (set_input_tensor semantics): the activation replaces
+    `batch` as forward_step_func's first argument, and the function
+    must skip its embedding path for non-first stages.
+
+    On the last stage the loss_func output is divided by
+    num_microbatches (common.py:308) so summing per-microbatch losses
+    yields a mean.
+    """
+    feed = batch if input_tensor is None else input_tensor
+    output, loss_func = forward_step_func(feed, model)
+    if loss_func is None:
+        return output, None
+    loss = loss_func(output)
+    return output, loss / num_microbatches
+
+
+def backward_step(forward_fn: Callable, params, inputs,
+                  output_grad: Optional[jax.Array] = None,
+                  grad_scale: Optional[jax.Array] = None):
+    """One microbatch backward ≡ backward_step (schedules/common.py:325-403).
+
+    `forward_fn(params, inputs) -> output` (activation or scalar loss).
+    Last stage passes output_grad=None and optionally `grad_scale` — the
+    GradScaler multiplication the reference applies to the first
+    backward's seed (common.py:378-379).  Returns
+    (input_grad, param_grads): input_grad is the activation gradient to
+    hand to the previous stage (the reference's p2p send_backward).
+    """
+    output, vjp = jax.vjp(forward_fn, params, inputs)
+    if output_grad is None:
+        seed = jnp.ones_like(output)
+        if grad_scale is not None:
+            seed = seed * jnp.asarray(grad_scale, seed.dtype)
+    else:
+        seed = output_grad
+    param_grads, input_grad = vjp(seed)
+    return input_grad, param_grads
+
+
+def free_output_tensor(output_tensors, deallocate_pipeline_outputs=False):
+    """≡ free_output_tensor (schedules/common.py:199-216).  XLA owns
+    buffer lifetimes; donation of the activation buffers in the jitted
+    step is the mechanism that reclaims them.  Kept as a no-op for
+    driver parity."""
+    return output_tensors
+
+
+def custom_backward(output, grad_output):
+    """≡ custom_backward (schedules/common.py:219-250) — a direct
+    autograd-engine call that skips the freed-buffer sanity check.  JAX
+    has no engine object; use jax.vjp (see backward_step)."""
+    raise NotImplementedError(
+        "custom_backward is a CUDA-engine workaround; use backward_step / "
+        "jax.vjp in apex_tpu")
+
+
+def get_params_for_weight_decay_optimization(params,
+                                             no_decay_names: Sequence[str] =
+                                             ("bias", "norm", "bn", "scale",
+                                              "offset")):
+    """≡ _get_params_for_weight_decay_optimization (common.py:162-196):
+    biases and norm parameters get no weight decay.
+
+    Returns a boolean pytree mask (True = apply weight decay) usable as
+    an optimizer `wd_mask`, instead of the reference's two param-group
+    dicts (JAX optimizers mask, they don't group).
+    """
+    def decide(path, leaf):
+        p = "/".join(str(k) for k in path).lower()
+        if any(n in p for n in no_decay_names):
+            return False
+        return hasattr(leaf, "ndim") and leaf.ndim >= 2
+
+    return jax.tree_util.tree_map_with_path(decide, params)

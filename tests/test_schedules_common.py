@@ -1,0 +1,201 @@
+"""Tests for pipeline schedules/common helpers, multi_tensor_apply
+dispatcher, fp16_utils facade, and the backend probe.
+
+Mirrors the reference's coverage of schedules/common.py (exercised via
+tests/L0/run_transformer/test_pipeline_parallel_fwd_bwd.py) and the L0
+multi-tensor tests (tests/L0/run_amp/test_multi_tensor_*.py).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import apex_tpu
+from apex_tpu import fp16_utils
+from apex_tpu.multi_tensor_apply import MultiTensorApply, multi_tensor_applier
+from apex_tpu.parallel import mesh
+from apex_tpu.transformer import _backend_util
+from apex_tpu.transformer.pipeline_parallel import common
+
+
+class TestMultiTensorApply:
+    def test_scale(self):
+        # ≡ tests/L0/run_amp/test_multi_tensor_scale.py: out = in * scale
+        xs = [jnp.arange(12.0).reshape(3, 4), jnp.ones((5,))]
+
+        def scale_op(noop, flats, scale):
+            (x,) = flats
+            return (x * scale,)
+
+        (out,) = multi_tensor_applier(scale_op, None, [xs], 0.5)
+        np.testing.assert_allclose(out[0], np.arange(12.0).reshape(3, 4) * 0.5)
+        np.testing.assert_allclose(out[1], 0.5 * np.ones(5))
+
+    def test_axpby_two_lists(self):
+        # ≡ test_multi_tensor_axpby.py: out = a*x + b*y
+        xs = [jnp.ones((2, 2)), jnp.full((3,), 2.0)]
+        ys = [jnp.full((2, 2), 10.0), jnp.full((3,), 20.0)]
+
+        def axpby(noop, flats, a, b):
+            x, y = flats
+            return (a * x + b * y, None)
+
+        out_x, out_y = multi_tensor_applier(axpby, None, [xs, ys], 2.0, 3.0)
+        np.testing.assert_allclose(out_x[0], 32.0 * np.ones((2, 2)))
+        np.testing.assert_allclose(out_y[1], 20.0 * np.ones(3))  # unchanged
+
+    def test_mismatched_lists_raise(self):
+        with pytest.raises(ValueError):
+            MultiTensorApply()(lambda n, f: f, None,
+                               [[jnp.ones(3)], [jnp.ones(3), jnp.ones(3)]])
+
+
+class TestFp16Utils:
+    def test_network_to_half_keeps_norm_fp32(self):
+        p = {"dense": {"kernel": jnp.ones((2, 2)), "bias": jnp.ones(2)},
+             "batchnorm": {"scale": jnp.ones(2)}}
+        h = fp16_utils.network_to_half(p, jnp.bfloat16)
+        assert h["dense"]["kernel"].dtype == jnp.bfloat16
+        assert h["batchnorm"]["scale"].dtype == jnp.float32
+
+    def test_dynamic_loss_scaler(self):
+        s = fp16_utils.DynamicLossScaler(init_scale=8.0, scale_window=2)
+        s.update_scale(jnp.asarray(True))
+        assert s.loss_scale == 4.0
+        s.update_scale(jnp.asarray(False))
+        s.update_scale(jnp.asarray(False))
+        assert s.loss_scale == 8.0
+
+    def test_static_scaler_constant(self):
+        s = fp16_utils.LossScaler(64.0)
+        s.update_scale(jnp.asarray(True))
+        assert s.loss_scale == 64.0
+        loss = s.scale_loss(jnp.asarray(2.0))
+        assert float(loss) == 128.0
+
+    def test_prep_param_lists_roundtrip(self):
+        p = {"w": jnp.ones((2, 2), jnp.bfloat16)}
+        model_p, master_p = fp16_utils.prep_param_lists(p)
+        assert jax.tree_util.tree_leaves(master_p)[0].dtype == jnp.float32
+        back = fp16_utils.master_params_to_model_params(master_p, model_p)
+        assert jax.tree_util.tree_leaves(back)[0].dtype == jnp.bfloat16
+
+
+class TestSchedulesCommon:
+    def test_build_model_placement_pp1(self):
+        mesh.initialize_model_parallel(tensor_model_parallel_size=1,
+                                       pipeline_model_parallel_size=1)
+        calls = []
+
+        def provider(pre_process=False, post_process=False):
+            calls.append((pre_process, post_process))
+            return {"w": jnp.zeros(1)}
+
+        models = common.build_model(provider, wrap_with_ddp=False)
+        assert len(models) == 1
+        assert calls == [(True, True)]
+
+    def test_build_model_interleaved_placement(self):
+        mesh.initialize_model_parallel(tensor_model_parallel_size=1,
+                                       pipeline_model_parallel_size=4)
+        calls = []
+
+        def provider(pre_process=False, post_process=False):
+            calls.append((pre_process, post_process))
+            return {}
+
+        models = common.build_model(
+            provider, wrap_with_ddp=False,
+            virtual_pipeline_model_parallel_size=2)
+        assert len(models) == 2
+        # Single-controller CPU harness: this process is stage 0 of 4 →
+        # chunk 0 is virtual stage 0 (pre), chunk 1 is virtual stage 4
+        # of 8 (neither pre nor post).
+        assert calls[0] == (True, False)
+        assert calls[1] == (False, False)
+
+    def test_build_model_vpp_requires_deep_pipeline(self):
+        mesh.initialize_model_parallel(tensor_model_parallel_size=1,
+                                       pipeline_model_parallel_size=2)
+        with pytest.raises(ValueError):
+            common.build_model(lambda **kw: {}, wrap_with_ddp=False,
+                               virtual_pipeline_model_parallel_size=2)
+
+    def test_forward_step_divides_loss(self):
+        def fwd(batch, model):
+            out = batch * model["w"]
+            return out, lambda o: jnp.sum(o)
+
+        model = {"w": jnp.asarray(2.0)}
+        out, loss = common.forward_step(fwd, jnp.ones(4), model, None,
+                                        num_microbatches=4)
+        np.testing.assert_allclose(out, 2.0 * np.ones(4))
+        assert float(loss) == pytest.approx(8.0 / 4)
+
+    def test_forward_step_uses_input_tensor(self):
+        def fwd(x, model):
+            return x + 1.0, None
+
+        out, loss = common.forward_step(fwd, jnp.zeros(3), {},
+                                        input_tensor=jnp.full((3,), 5.0))
+        np.testing.assert_allclose(out, 6.0 * np.ones(3))
+        assert loss is None
+
+    def test_backward_step_chain_matches_full_grad(self):
+        # Two "stages" f2(f1(x)); chained backward_step must equal
+        # jax.grad of the composition (the reference's race-condition
+        # style analytic check).
+        p1 = {"w": jnp.asarray(3.0)}
+        p2 = {"v": jnp.asarray(5.0)}
+        x = jnp.arange(4.0)
+
+        def f1(p, x):
+            return p["w"] * x
+
+        def f2(p, h):
+            return jnp.sum(p["v"] * h ** 2)
+
+        h = f1(p1, x)
+        # last stage: seed = 1 (scalar loss)
+        g_h, g_p2 = common.backward_step(f2, p2, h)
+        g_x, g_p1 = common.backward_step(f1, p1, x, output_grad=g_h)
+
+        full = jax.grad(lambda p1_, p2_: f2(p2_, f1(p1_, x)),
+                        argnums=(0, 1))(p1, p2)
+        np.testing.assert_allclose(g_p1["w"], full[0]["w"], rtol=1e-6)
+        np.testing.assert_allclose(g_p2["v"], full[1]["v"], rtol=1e-6)
+
+    def test_backward_step_grad_scale(self):
+        def f(p, x):
+            return p["w"] * x
+
+        p = {"w": jnp.asarray(2.0)}
+        _, g = common.backward_step(f, p, jnp.ones(3), grad_scale=4.0)
+        np.testing.assert_allclose(g["w"], 12.0)
+
+    def test_weight_decay_split(self):
+        params = {"block": {"kernel": jnp.ones((3, 3)),
+                            "bias": jnp.ones(3)},
+                  "layernorm": {"scale": jnp.ones(3)}}
+        mask = common.get_params_for_weight_decay_optimization(params)
+        assert mask["block"]["kernel"] is True
+        assert mask["block"]["bias"] is False
+        assert mask["layernorm"]["scale"] is False
+
+    def test_custom_backward_raises(self):
+        with pytest.raises(NotImplementedError):
+            common.custom_backward(jnp.ones(1), jnp.ones(1))
+
+
+class TestBackendUtil:
+    def test_probe(self):
+        assert _backend_util.HAS_UCC is False
+        assert _backend_util.default_backend() == "cpu"
+        assert _backend_util.backend_available("cpu")
+        assert not _backend_util.backend_available("nonexistent")
+
+
+def test_deprecated_warning_emits():
+    with pytest.warns(FutureWarning):
+        apex_tpu.deprecated_warning("old thing")
