@@ -70,3 +70,20 @@ from apex_tpu import ops  # noqa: E402,F401
 from apex_tpu import optimizers  # noqa: E402,F401
 from apex_tpu import amp  # noqa: E402,F401
 from apex_tpu import transformer  # noqa: E402,F401
+
+
+_LAZY_SUBMODULES = {
+    # reference name parity (apex/__init__.py lazy subpackages)
+    "contrib", "fp16_utils", "models", "normalization", "mlp",
+    "fused_dense", "multi_tensor_apply", "checkpoint", "rnn",
+}
+
+
+def __getattr__(name):
+    import importlib
+
+    if name in _LAZY_SUBMODULES:
+        return importlib.import_module(f"apex_tpu.{name}")
+    if name == "RNN":  # ≡ apex.RNN (apex/RNN/__init__.py)
+        return importlib.import_module("apex_tpu.rnn")
+    raise AttributeError(f"module 'apex_tpu' has no attribute {name!r}")

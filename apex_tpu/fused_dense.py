@@ -1,0 +1,16 @@
+"""apex_tpu.fused_dense — fused linear(+bias)(+GELU) (≡ apex.fused_dense,
+apex/fused_dense/fused_dense.py:7-99).
+
+Parity shim re-exporting the fused dense kernels from the ops layer.
+"""
+
+from apex_tpu.ops.fused_dense import (  # noqa: F401
+    FusedDense,
+    FusedDenseGeluDense,
+    linear_bias,
+    linear_gelu_linear,
+    wgrad_accum,
+)
+
+__all__ = ["FusedDense", "FusedDenseGeluDense", "linear_bias",
+           "linear_gelu_linear", "wgrad_accum"]

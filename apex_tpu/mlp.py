@@ -1,0 +1,8 @@
+"""apex_tpu.mlp — fused MLP (≡ apex.mlp, apex/mlp/mlp.py:11-87).
+
+Parity shim re-exporting the Pallas/XLA-fused MLP from the ops layer.
+"""
+
+from apex_tpu.ops.mlp import MLP, mlp_forward  # noqa: F401
+
+__all__ = ["MLP", "mlp_forward"]

@@ -1,0 +1,29 @@
+"""apex_tpu.normalization — fused LayerNorm/RMSNorm (≡ apex.normalization).
+
+Parity shim over the Pallas kernel layer: the reference package
+(apex/normalization/__init__.py, fused_layer_norm.py:204-438) exports
+module classes and functional forms; both live in
+`apex_tpu.ops.layer_norm` and are re-exported here under the reference
+names.
+"""
+
+from apex_tpu.ops.layer_norm import (  # noqa: F401
+    FusedLayerNorm,
+    FusedRMSNorm,
+    fused_layer_norm,
+    fused_rms_norm,
+    layer_norm_reference,
+    rms_norm_reference,
+)
+
+# Megatron "mixed dtype" variants (fused_layer_norm.py:398-438) are the
+# same kernels with fp32 stats/params over low-precision activations —
+# the kernel always computes stats in fp32, so the aliases are exact.
+MixedFusedLayerNorm = FusedLayerNorm
+MixedFusedRMSNorm = FusedRMSNorm
+
+__all__ = [
+    "FusedLayerNorm", "FusedRMSNorm", "MixedFusedLayerNorm",
+    "MixedFusedRMSNorm", "fused_layer_norm", "fused_rms_norm",
+    "layer_norm_reference", "rms_norm_reference",
+]

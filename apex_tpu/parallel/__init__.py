@@ -33,14 +33,20 @@ def __getattr__(name):
     # Lazy imports for heavier submodules (importlib avoids re-entering
     # this __getattr__ during the submodule's own import).
     import importlib
-    if name in ("ddp", "sync_batchnorm", "larc", "clip_grad"):
+    if name in ("ddp", "sync_batchnorm", "larc", "clip_grad", "multiproc",
+                "context_parallel"):
         return importlib.import_module(f"apex_tpu.parallel.{name}")
     if name == "DistributedDataParallel":
         return importlib.import_module(
             "apex_tpu.parallel.ddp").DistributedDataParallel
+    if name == "Reducer":  # ≡ apex.parallel.Reducer (distributed.py:91)
+        return importlib.import_module("apex_tpu.parallel.ddp").Reducer
     if name == "SyncBatchNorm":
         return importlib.import_module(
             "apex_tpu.parallel.sync_batchnorm").SyncBatchNorm
+    if name == "convert_syncbn_model":  # ≡ apex/parallel/__init__.py:21
+        return importlib.import_module(
+            "apex_tpu.parallel.sync_batchnorm").convert_syncbn_model
     if name == "LARC":
         return importlib.import_module("apex_tpu.parallel.larc").LARC
     raise AttributeError(name)

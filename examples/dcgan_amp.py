@@ -1,0 +1,239 @@
+"""DCGAN under AMP — the multi-model / multi-optimizer / multi-loss demo.
+
+≡ examples/dcgan/main_amp.py in the reference: two networks (G, D),
+two optimizers, and THREE losses per iteration (errD_real, errD_fake,
+errG) each with its own loss scaler — exercising
+`amp.initialize(num_losses=3)` the way the reference does
+(main_amp.py: amp.initialize([netD, netG], [optimizerD, optimizerG],
+num_losses=3).
+
+TPU-first differences: NHWC layout, transposed convs via
+`lax.conv_transpose`, both G and D steps fused into single jitted
+updates with per-loss dynamic scaler states, synthetic data by default
+(the reference's `--dataset fake` mode) so the example runs anywhere.
+
+Run (tiny, CPU ok):
+    python examples/dcgan_amp.py --image-size 32 --iters 20
+"""
+
+from __future__ import annotations
+
+import os as _os
+import sys as _sys
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
+
+import argparse
+import time
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from apex_tpu import amp
+from apex_tpu.amp import scaler as scaler_lib
+from apex_tpu.optimizers.fused_adam import FusedAdam
+
+
+# ---------------------------------------------------------------- models
+def _conv_init(key, kh, kw, cin, cout, dtype=jnp.float32):
+    std = 0.02  # DCGAN init: N(0, 0.02) (main_amp.py weights_init)
+    return jax.random.normal(key, (kh, kw, cin, cout), dtype) * std
+
+
+def _bn_params(c):
+    return {"scale": jnp.ones((c,)), "bias": jnp.zeros((c,))}
+
+
+def _bn(x, p, eps=1e-5):
+    # Per-batch BN (training-mode stats only, as in the GAN training loop).
+    mean = jnp.mean(x, axis=(0, 1, 2))
+    var = jnp.var(x, axis=(0, 1, 2))
+    inv = lax.rsqrt(var + eps)
+    return (x - mean) * inv * p["scale"] + p["bias"]
+
+
+class Generator:
+    """z (N,1,1,nz) → image (N,S,S,nc); mirrors the reference netG
+    (ConvTranspose2d stack, BN+ReLU, tanh head)."""
+
+    def __init__(self, image_size=32, nz=100, ngf=64, nc=3):
+        assert image_size in (32, 64)
+        self.image_size, self.nz, self.ngf, self.nc = image_size, nz, ngf, nc
+        # (cin, cout, stride) per deconv layer, 4x4 kernels throughout.
+        mult = image_size // 8  # 4 for 32, 8 for 64
+        chain = [(nz, ngf * mult, 1)]
+        while mult > 1:
+            chain.append((ngf * mult, ngf * mult // 2, 2))
+            mult //= 2
+        chain.append((ngf, nc, 2))
+        self.chain = chain
+
+    def init(self, key):
+        params = []
+        for i, (cin, cout, _s) in enumerate(self.chain):
+            key, k = jax.random.split(key)
+            p = {"w": _conv_init(k, 4, 4, cin, cout)}
+            if i < len(self.chain) - 1:
+                p["bn"] = _bn_params(cout)
+            params.append(p)
+        return params
+
+    def __call__(self, params, z, policy=None):
+        x = z
+        compute = (policy.cast_to_compute if policy else (lambda t: t))
+        for i, ((_cin, _cout, s), p) in enumerate(zip(self.chain, params)):
+            pad = "VALID" if i == 0 else "SAME"
+            x = lax.conv_transpose(
+                compute(x), compute(p["w"]), strides=(s, s), padding=pad,
+                dimension_numbers=("NHWC", "HWIO", "NHWC"))
+            if i < len(self.chain) - 1:
+                x = jax.nn.relu(_bn(x.astype(jnp.float32), p["bn"]))
+            else:
+                x = jnp.tanh(x.astype(jnp.float32))
+        return x
+
+
+class Discriminator:
+    """image → logit; Conv stride-2 stack, LeakyReLU(0.2), BN."""
+
+    def __init__(self, image_size=32, ndf=64, nc=3):
+        mult, chain, cin = 1, [], nc
+        size = image_size
+        while size > 4:
+            chain.append((cin, ndf * mult, 2))
+            cin, mult, size = ndf * mult, mult * 2, size // 2
+        chain.append((cin, 1, 1))  # 4x4 VALID → 1x1 logit
+        self.chain = chain
+
+    def init(self, key):
+        params = []
+        for i, (cin, cout, _s) in enumerate(self.chain):
+            key, k = jax.random.split(key)
+            p = {"w": _conv_init(k, 4, 4, cin, cout)}
+            if 0 < i < len(self.chain) - 1:
+                p["bn"] = _bn_params(cout)
+            params.append(p)
+        return params
+
+    def __call__(self, params, x, policy=None):
+        compute = (policy.cast_to_compute if policy else (lambda t: t))
+        for i, ((_cin, _cout, s), p) in enumerate(zip(self.chain, params)):
+            pad = "VALID" if i == len(self.chain) - 1 else "SAME"
+            x = lax.conv_general_dilated(
+                compute(x), compute(p["w"]), window_strides=(s, s),
+                padding=pad, dimension_numbers=("NHWC", "HWIO", "NHWC"))
+            if i < len(self.chain) - 1:
+                if "bn" in p:
+                    x = _bn(x.astype(jnp.float32), p["bn"])
+                x = jax.nn.leaky_relu(x.astype(jnp.float32), 0.2)
+        return x.reshape(x.shape[0])  # logits
+
+
+def bce_with_logits(logits, target):
+    # stable BCEWithLogitsLoss ≡ nn.BCELoss(sigmoid) in the reference
+    return jnp.mean(jnp.clip(logits, 0) - logits * target +
+                    jnp.log1p(jnp.exp(-jnp.abs(logits))))
+
+
+# ---------------------------------------------------------------- steps
+def make_steps(G, D, optG, optD, policy):
+    """Two jitted updates; three independent loss-scaler states
+    (errD_real → scaler 0, errD_fake → scaler 1, errG → scaler 2), the
+    loss_id plumbing of amp.scale_loss(..., loss_id=i)."""
+
+    dynamic = policy.loss_scale == "dynamic"
+
+    def d_real_loss(dp, real, s):
+        l = bce_with_logits(D(dp, real, policy), 1.0)
+        return scaler_lib.scale_loss(s, l), l
+
+    def d_fake_loss(dp, fake, s):
+        l = bce_with_logits(D(dp, fake, policy), 0.0)
+        return scaler_lib.scale_loss(s, l), l
+
+    def g_loss(gp, dp, z, s_g):
+        fake = G(gp, z, policy)
+        lg_ = bce_with_logits(D(dp, fake, policy), 1.0)
+        return scaler_lib.scale_loss(s_g, lg_), lg_
+
+    @jax.jit
+    def d_step(dp, d_state, gp, real, z, s_real, s_fake):
+        # Two backwards, one per loss/scaler, grads accumulated — exactly
+        # the reference's errD_real.backward(); errD_fake.backward() under
+        # separate loss_ids.  Each contribution is unscaled by ITS OWN
+        # scaler before summing, so the scalers may diverge freely.
+        fake = lax.stop_gradient(G(gp, z, policy))
+        (_, lr_), g_r = jax.value_and_grad(
+            d_real_loss, has_aux=True)(dp, real, s_real)
+        (_, lf_), g_f = jax.value_and_grad(
+            d_fake_loss, has_aux=True)(dp, fake, s_fake)
+        g_r, found_r = scaler_lib.unscale(s_real, g_r)
+        g_f, found_f = scaler_lib.unscale(s_fake, g_f)
+        grads = jax.tree.map(jnp.add, g_r, g_f)
+        found = jnp.logical_or(found_r, found_f)
+        s_real2 = scaler_lib.update(s_real, found_r, dynamic=dynamic)
+        s_fake2 = scaler_lib.update(s_fake, found_f, dynamic=dynamic)
+        dp, d_state = optD.step(d_state, grads, found_inf=found)
+        return dp, d_state, s_real2, s_fake2, lr_ + lf_
+
+    @jax.jit
+    def g_step(gp, g_state, dp, z, s_g):
+        (_, lg_), grads = jax.value_and_grad(
+            g_loss, has_aux=True)(gp, dp, z, s_g)
+        grads, found = scaler_lib.unscale(s_g, grads)
+        s_g2 = scaler_lib.update(s_g, found, dynamic=dynamic)
+        gp, g_state = optG.step(g_state, grads, found_inf=found)
+        return gp, g_state, s_g2, lg_
+
+    return d_step, g_step
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--image-size", type=int, default=32)
+    ap.add_argument("--nz", type=int, default=100)
+    ap.add_argument("--iters", type=int, default=100)
+    ap.add_argument("--lr", type=float, default=2e-4)
+    ap.add_argument("--beta1", type=float, default=0.5)
+    ap.add_argument("--opt-level", default="O1")
+    args = ap.parse_args()
+
+    G = Generator(args.image_size, args.nz)
+    D = Discriminator(args.image_size)
+    kg, kd, kdata = jax.random.split(jax.random.PRNGKey(0), 3)
+    gp, dp = G.init(kg), D.init(kd)
+    # ≡ amp.initialize([netD, netG], [optD, optG], num_losses=3): under
+    # O2/O3 this casts both nets' params (BN kept fp32 under O2).
+    (gp, dp), amp_state = amp.initialize((gp, dp),
+                                         opt_level=args.opt_level,
+                                         num_losses=3)
+    policy = amp_state.policy
+    s_real, s_fake, s_g = amp_state.loss_scalers
+    optG = FusedAdam(lr=args.lr, betas=(args.beta1, 0.999))
+    optD = FusedAdam(lr=args.lr, betas=(args.beta1, 0.999))
+    g_state, d_state = optG.init(gp), optD.init(dp)
+
+    d_step, g_step = make_steps(G, D, optG, optD, policy)
+
+    t0 = time.time()
+    for it in range(args.iters):
+        kdata, kz1, kz2, kx = jax.random.split(kdata, 4)
+        real = jax.random.uniform(kx, (args.batch_size, args.image_size,
+                                       args.image_size, 3)) * 2 - 1
+        z1 = jax.random.normal(kz1, (args.batch_size, 1, 1, args.nz))
+        z2 = jax.random.normal(kz2, (args.batch_size, 1, 1, args.nz))
+        dp, d_state, s_real, s_fake, errD = d_step(
+            dp, d_state, gp, real, z1, s_real, s_fake)
+        gp, g_state, s_g, errG = g_step(gp, g_state, dp, z2, s_g)
+        if it % 10 == 0 or it == args.iters - 1:
+            print(f"[{it}/{args.iters}] Loss_D {float(errD):.4f} "
+                  f"Loss_G {float(errG):.4f} "
+                  f"scale {float(s_g.scale):.0f}")
+    dt = time.time() - t0
+    print(f"{args.iters} iters in {dt:.1f}s "
+          f"({args.iters * args.batch_size / dt:.0f} img/s)")
+
+
+if __name__ == "__main__":
+    main()
