@@ -31,6 +31,11 @@ from apex_tpu.ops._common import pallas_interpret, use_pallas
 _LANES = 128
 _BLOCK_ROWS = 512  # (512, 128) fp32 tile = 256 KiB per operand
 
+# Flat buffers created at optimizer init should be padded to this length
+# multiple (optimizers/flat.py flatten(pad_to=...)); _to2d is then a free
+# bitcast and the kernels run fully in place via input_output_aliases.
+FLAT_TILE = _BLOCK_ROWS * _LANES
+
 
 def _to2d(flat):
     n = flat.shape[0]

@@ -40,12 +40,22 @@ def make_spec(tree) -> FlatSpec:
                     sizes=sizes, offsets=offsets, total=int(sum(sizes)))
 
 
-def flatten(tree, dtype=jnp.float32):
-    """Concatenate all leaves into one 1-D buffer (cast to `dtype`)."""
+def flatten(tree, dtype=jnp.float32, pad_to: int = 1):
+    """Concatenate all leaves into one 1-D buffer (cast to `dtype`).
+
+    `pad_to` rounds the buffer length up to a multiple (zeros appended) so
+    downstream Pallas kernels see tile-aligned shapes and update in place
+    — without it every optimizer step would re-pad (a full HBM copy that
+    also breaks the donation chain).  unflatten ignores the tail.
+    """
     leaves = jax.tree_util.tree_leaves(tree)
     if not leaves:
         return jnp.zeros((0,), dtype)
-    return jnp.concatenate([l.astype(dtype).reshape(-1) for l in leaves])
+    flat = jnp.concatenate([l.astype(dtype).reshape(-1) for l in leaves])
+    pad = (-flat.shape[0]) % pad_to
+    if pad:
+        flat = jnp.pad(flat, (0, pad))
+    return flat
 
 
 def unflatten(flat, spec: FlatSpec, cast_to_leaf_dtype: bool = True):

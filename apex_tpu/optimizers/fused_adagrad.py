@@ -32,12 +32,12 @@ class FusedAdagrad:
 
     def init(self, params) -> FusedAdagradState:
         self.spec = F.make_spec(params)
-        flat = F.flatten(params, jnp.float32)
+        flat = F.flatten(params, jnp.float32, pad_to=K.FLAT_TILE)
         return FusedAdagradState(step=jnp.zeros((), jnp.int32), params=flat,
                                  sum_sq=jnp.zeros_like(flat))
 
     def step(self, state: FusedAdagradState, grads, lr=None):
-        g_flat = F.flatten(grads, jnp.float32)
+        g_flat = F.flatten(grads, jnp.float32, pad_to=K.FLAT_TILE)
         p, h = K.adagrad_flat(
             state.params, state.sum_sq, g_flat,
             lr=self.lr if lr is None else lr, eps=self.eps,

@@ -53,7 +53,7 @@ class FusedAdam:
 
     def init(self, params) -> FusedAdamState:
         self.spec = F.make_spec(params)
-        flat = F.flatten(params, jnp.float32)
+        flat = F.flatten(params, jnp.float32, pad_to=K.FLAT_TILE)
         zeros = jnp.zeros_like(flat)
         return FusedAdamState(step=jnp.zeros((), jnp.int32), params=flat,
                               exp_avg=zeros, exp_avg_sq=zeros)
@@ -63,7 +63,7 @@ class FusedAdam:
         """One fused step.  Returns (params_pytree, new_state)."""
         if self.spec is None:
             raise RuntimeError("call init(params) before step()")
-        g_flat = F.flatten(grads, jnp.float32)
+        g_flat = F.flatten(grads, jnp.float32, pad_to=K.FLAT_TILE)
         found = jnp.asarray(found_inf)
         step_next = state.step + jnp.where(found, 0, 1).astype(jnp.int32)
         p, m, v = K.adam_flat(

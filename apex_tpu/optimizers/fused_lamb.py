@@ -49,14 +49,14 @@ class FusedLAMB:
 
     def init(self, params) -> FusedLAMBState:
         self.spec = F.make_spec(params)
-        flat = F.flatten(params, jnp.float32)
+        flat = F.flatten(params, jnp.float32, pad_to=K.FLAT_TILE)
         zeros = jnp.zeros_like(flat)
         return FusedLAMBState(step=jnp.zeros((), jnp.int32), params=flat,
                               exp_avg=zeros, exp_avg_sq=zeros)
 
     def step(self, state: FusedLAMBState, grads, lr=None, inv_scale=1.0,
              found_inf=False):
-        g_flat = F.flatten(grads, jnp.float32) * jnp.asarray(
+        g_flat = F.flatten(grads, jnp.float32, pad_to=K.FLAT_TILE) * jnp.asarray(
             inv_scale, jnp.float32)
         found = jnp.asarray(found_inf)
         step_next = state.step + jnp.where(found, 0, 1).astype(jnp.int32)
@@ -88,7 +88,7 @@ class FusedLAMB:
         un = K.per_tensor_l2norm(u, sizes)
         ratio = jnp.where((wn > 0) & (un > 0), wn / jnp.maximum(un, 1e-12),
                           1.0)
-        ratio_elem = K.expand_per_tensor(ratio, sizes, self.spec.total)
+        ratio_elem = K.expand_per_tensor(ratio, sizes, state.params.shape[0])
 
         p_new = K.lamb_phase2_flat(state.params, u, ratio_elem, lr_val,
                                    use_pallas_override=self.use_pallas)

@@ -45,7 +45,7 @@ class FusedNovoGrad:
 
     def init(self, params) -> FusedNovoGradState:
         self.spec = F.make_spec(params)
-        flat = F.flatten(params, jnp.float32)
+        flat = F.flatten(params, jnp.float32, pad_to=K.FLAT_TILE)
         n_tensors = len(self.spec.sizes)
         return FusedNovoGradState(
             step=jnp.zeros((), jnp.int32), params=flat,
@@ -54,7 +54,7 @@ class FusedNovoGrad:
 
     def step(self, state: FusedNovoGradState, grads, lr=None, inv_scale=1.0,
              found_inf=False):
-        g_flat = F.flatten(grads, jnp.float32) * jnp.asarray(
+        g_flat = F.flatten(grads, jnp.float32, pad_to=K.FLAT_TILE) * jnp.asarray(
             inv_scale, jnp.float32)
         found = jnp.asarray(found_inf)
         step_next = state.step + jnp.where(found, 0, 1).astype(jnp.int32)
@@ -73,7 +73,7 @@ class FusedNovoGrad:
             v_new = jnp.where(first, gn2, v_cont)
 
         denom = jnp.sqrt(v_new) + self.eps
-        denom_elem = K.expand_per_tensor(denom, sizes, self.spec.total)
+        denom_elem = K.expand_per_tensor(denom, sizes, state.params.shape[0])
 
         p32 = state.params
         gg = g_flat / denom_elem

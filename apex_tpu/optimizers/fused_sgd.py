@@ -39,13 +39,13 @@ class FusedSGD:
 
     def init(self, params) -> FusedSGDState:
         self.spec = F.make_spec(params)
-        flat = F.flatten(params, jnp.float32)
+        flat = F.flatten(params, jnp.float32, pad_to=K.FLAT_TILE)
         return FusedSGDState(step=jnp.zeros((), jnp.int32), params=flat,
                              momentum_buffer=jnp.zeros_like(flat))
 
     def step(self, state: FusedSGDState, grads, lr=None, inv_scale=1.0,
              found_inf=False):
-        g_flat = F.flatten(grads, jnp.float32)
+        g_flat = F.flatten(grads, jnp.float32, pad_to=K.FLAT_TILE)
         found = jnp.asarray(found_inf)
         # first_run initializes the momentum buffer with the raw grad
         # (≡ torch SGD buf-is-None branch); branch-free via buffer math:

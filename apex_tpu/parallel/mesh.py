@@ -45,6 +45,7 @@ class _MeshState:
     # (parallel_state.py:700-712).
     virtual_pipeline_model_parallel_rank: int = 0
     pipeline_model_parallel_split_rank: Optional[int] = None
+    use_fp8: bool = False
 
 
 class MeshNotInitializedError(RuntimeError):
@@ -56,6 +57,7 @@ def initialize_model_parallel(
     pipeline_model_parallel_size: int = 1,
     virtual_pipeline_model_parallel_size: Optional[int] = None,
     pipeline_model_parallel_split_rank: Optional[int] = None,
+    use_fp8: bool = False,
     devices: Optional[Sequence[jax.Device]] = None,
 ) -> Mesh:
     """Build the global (pp, dp, tp) mesh.
@@ -89,6 +91,7 @@ def initialize_model_parallel(
         data_parallel_size=dp,
         virtual_pipeline_model_parallel_size=virtual_pipeline_model_parallel_size,
         pipeline_model_parallel_split_rank=pipeline_model_parallel_split_rank,
+        use_fp8=use_fp8,
     )
     return mesh
 
@@ -196,3 +199,192 @@ def data_parallel_sharding(ndim: int) -> NamedSharding:
     """Batch-dim sharding over dp (and pp folded in when pp==1 is absent)."""
     spec = [DP_AXIS] + [None] * (ndim - 1)
     return named_sharding(*spec)
+
+
+# --- group membership (pipeline-stage sets replacing process groups) -------
+#
+# The reference builds dedicated process groups for tied-embedding /
+# position-embedding / relative-position-embedding gradient exchange
+# (parallel_state.py:321-407) and fp8 amax reduction (280-292).  Under one
+# SPMD mesh those become *sets of pipeline stages* (every (dp, tp)
+# coordinate participates alike) plus the mesh axes to reduce over.
+
+def _split(s: _MeshState) -> Optional[int]:
+    return s.pipeline_model_parallel_split_rank
+
+
+def get_embedding_group_stages() -> list:
+    """Pipeline stages that hold tied input/output embeddings.
+
+    ≡ embedding_ranks construction (parallel_state.py:352-370): [first,
+    last], with the encoder/decoder split stage inserted when set.
+    """
+    s = _state()
+    pp = s.pipeline_model_parallel_size
+    if pp == 1:
+        return [0]
+    stages = [0, pp - 1]
+    sp = _split(s)
+    if sp is not None and sp not in stages:
+        stages = [0, sp, pp - 1]
+    return stages
+
+
+def get_position_embedding_group_stages() -> list:
+    """≡ position_embedding_ranks (parallel_state.py:355,367-370)."""
+    s = _state()
+    if s.pipeline_model_parallel_size == 1:
+        return [0]
+    sp = _split(s)
+    return [0] if sp in (None, 0) else [0, sp]
+
+
+def get_encoder_relative_position_embedding_group_stages() -> list:
+    """≡ encoder_relative_position_embedding_ranks (parallel_state.py:356-363)."""
+    s = _state()
+    pp = s.pipeline_model_parallel_size
+    if pp == 1:
+        return [0]
+    sp = _split(s)
+    return [0] if sp is None else list(range(sp))
+
+
+def get_decoder_relative_position_embedding_group_stages() -> list:
+    """≡ decoder_relative_position_embedding_ranks (parallel_state.py:356-365)."""
+    s = _state()
+    pp = s.pipeline_model_parallel_size
+    if pp == 1:
+        return [0]
+    sp = _split(s)
+    return [0] if sp is None else list(range(sp, pp))
+
+
+def is_rank_in_embedding_group(stage: int) -> bool:
+    """≡ parallel_state.is_rank_in_embedding_group for a host-driven stage."""
+    return stage in get_embedding_group_stages()
+
+
+def is_rank_in_position_embedding_group(stage: int) -> bool:
+    return stage in get_position_embedding_group_stages()
+
+
+def is_pipeline_stage_before_split(stage: Optional[int] = None) -> bool:
+    """≡ parallel_state.is_pipeline_stage_before_split: True when the stage
+    executes encoder layers (always True without an encoder/decoder split)."""
+    s = _state()
+    sp = _split(s)
+    if sp is None:
+        return True
+    if stage is None:
+        raise ValueError("stage index required under SPMD (no implicit rank)")
+    return stage < sp
+
+
+def is_pipeline_stage_after_split(stage: Optional[int] = None) -> bool:
+    s = _state()
+    sp = _split(s)
+    if sp is None:
+        return True
+    if stage is None:
+        raise ValueError("stage index required under SPMD (no implicit rank)")
+    return stage >= sp
+
+
+def is_pipeline_stage_at_split(stage: int) -> bool:
+    """True when `stage` runs the last encoder block and `stage+1` the first
+    decoder block (≡ parallel_state.is_pipeline_stage_at_split)."""
+    return is_pipeline_stage_before_split(stage) and is_pipeline_stage_after_split(
+        stage + 1
+    )
+
+
+def set_pipeline_model_parallel_split_rank(rank: Optional[int]) -> None:
+    _state().pipeline_model_parallel_split_rank = rank
+
+
+# --- pipeline rank math ----------------------------------------------------
+
+def get_pipeline_model_parallel_next_rank(stage: int) -> int:
+    """Next stage index, wrapping — the ppermute source/dest math that
+    replaces _PIPELINE_GLOBAL_RANKS lookups (parallel_state.py:737-752)."""
+    return (stage + 1) % _state().pipeline_model_parallel_size
+
+
+def get_pipeline_model_parallel_prev_rank(stage: int) -> int:
+    return (stage - 1) % _state().pipeline_model_parallel_size
+
+
+def get_pipeline_model_parallel_first_rank() -> int:
+    return 0
+
+
+def get_pipeline_model_parallel_last_rank() -> int:
+    return _state().pipeline_model_parallel_size - 1
+
+
+def get_pipeline_global_device_ranks(dp_index: int = 0, tp_index: int = 0) -> list:
+    """Flat device indices of one pipeline group — range(i, world,
+    world//pp) in the reference's rank ordering (parallel_state.py:345-348).
+    With the (pp, dp, tp) row-major mesh this is stage*dp*tp + dp_index*tp
+    + tp_index for each stage."""
+    s = _state()
+    stride = s.data_parallel_size * s.tensor_model_parallel_size
+    base = dp_index * s.tensor_model_parallel_size + tp_index
+    return [base + stage * stride for stage in
+            range(s.pipeline_model_parallel_size)]
+
+
+def get_tensor_model_parallel_src_rank(device_rank: int) -> int:
+    """First flat device index of `device_rank`'s TP group
+    (≡ parallel_state.get_tensor_model_parallel_src_rank:713-718)."""
+    tp = _state().tensor_model_parallel_size
+    return (device_rank // tp) * tp
+
+
+def get_data_parallel_src_rank(device_rank: int) -> int:
+    """First flat device index of `device_rank`'s DP group.
+
+    ≡ parallel_state.get_data_parallel_src_rank:721-726 in intent.  The
+    reference computes ``rank % num_dp_groups``, which only names the
+    group's first member when pp == 1; here the first member is derived
+    from the (pp, dp, tp) coordinates directly so it is correct for any
+    pipeline depth: same stage, dp index 0, same tp index.
+    """
+    s = _state()
+    stage_size = s.data_parallel_size * s.tensor_model_parallel_size
+    stage_base = (device_rank // stage_size) * stage_size
+    return stage_base + device_rank % s.tensor_model_parallel_size
+
+
+# --- fp8 amax reduction ----------------------------------------------------
+
+def fp8_is_enabled() -> bool:
+    return _state().use_fp8
+
+
+def get_amax_reduction_axes() -> tuple:
+    """Mesh axes spanning one amax-reduction group.
+
+    The reference's amax group is tp*dp contiguous ranks — exactly one
+    pipeline stage's (dp, tp) plane under this mesh layout
+    (parallel_state.py:280-292).  Reduce over these axes inside
+    shard_map, e.g. ``lax.pmax(amax, get_amax_reduction_axes())``.
+    """
+    if not _state().use_fp8:
+        raise MeshNotInitializedError(
+            "AMAX reduction group is not initialized; pass use_fp8=True to "
+            "initialize_model_parallel"
+        )
+    return (DP_AXIS, TP_AXIS)
+
+
+def reduce_amax(x):
+    """pmax of a per-shard amax over the amax-reduction group; call inside
+    shard_map over the global mesh."""
+    return jax.lax.pmax(x, get_amax_reduction_axes())
+
+
+def get_model_parallel_axes() -> tuple:
+    """Axes of the model-parallel group (pp × tp plane) — e.g. for the
+    MP-aware GradScaler's found_inf reduction (amp/grad_scaler.py:44-55)."""
+    return (PP_AXIS, TP_AXIS)

@@ -121,3 +121,70 @@ def test_ring_exchange_and_halo():
     for r in range(8):
         expect += [(4 * r - 1) % 32, (4 * r + 4) % 32]
     np.testing.assert_allclose(out, np.array(expect, dtype=np.float32))
+
+
+def test_group_stage_sets_and_rank_math():
+    """Embedding / position-embedding / relative-pos / amax group parity.
+
+    ≡ the group-construction logic of parallel_state.initialize_model_parallel
+    (parallel_state.py:280-407) checked as stage sets and flat-rank math.
+    """
+    M.destroy_model_parallel()
+    M.initialize_model_parallel(tensor_model_parallel_size=2,
+                                pipeline_model_parallel_size=4,
+                                pipeline_model_parallel_split_rank=2,
+                                use_fp8=True)
+    assert M.get_embedding_group_stages() == [0, 2, 3]
+    assert M.get_position_embedding_group_stages() == [0, 2]
+    assert M.get_encoder_relative_position_embedding_group_stages() == [0, 1]
+    assert M.get_decoder_relative_position_embedding_group_stages() == [2, 3]
+    assert M.is_rank_in_embedding_group(3) and not M.is_rank_in_embedding_group(1)
+    assert M.is_pipeline_stage_before_split(1)
+    assert not M.is_pipeline_stage_before_split(2)
+    assert M.is_pipeline_stage_after_split(2)
+    assert M.is_pipeline_stage_at_split(1)
+    assert not M.is_pipeline_stage_at_split(2)
+
+    # pipeline rank math: stride between stages is dp*tp = world//pp
+    assert M.get_pipeline_model_parallel_next_rank(3) == 0
+    assert M.get_pipeline_model_parallel_prev_rank(0) == 3
+    assert M.get_pipeline_global_device_ranks(dp_index=0, tp_index=1) == \
+        [1, 3, 5, 7]
+    assert M.get_tensor_model_parallel_src_rank(5) == 4
+    # dp=1 here: every device is its own DP group
+    assert M.get_data_parallel_src_rank(7) == 7
+    assert M.get_amax_reduction_axes() == ("dp", "tp")
+    assert M.get_model_parallel_axes() == ("pp", "tp")
+    M.destroy_model_parallel()
+
+    # no split, pp=2, tp=2, dp=2: embedding group = first+last
+    M.initialize_model_parallel(tensor_model_parallel_size=2,
+                                pipeline_model_parallel_size=2)
+    # device 7 = stage 1, dp 1, tp 1 -> DP group {5, 7}, first member 5
+    assert M.get_data_parallel_src_rank(7) == 5
+    assert M.get_data_parallel_src_rank(2) == 0
+    M.destroy_model_parallel()
+    M.initialize_model_parallel(pipeline_model_parallel_size=2)
+    assert M.get_embedding_group_stages() == [0, 1]
+    assert M.get_position_embedding_group_stages() == [0]
+    assert M.get_encoder_relative_position_embedding_group_stages() == [0]
+    with pytest.raises(M.MeshNotInitializedError):
+        M.get_amax_reduction_axes()
+    M.destroy_model_parallel()
+
+
+def test_reduce_amax_under_shard_map():
+    mesh = M.initialize_model_parallel(tensor_model_parallel_size=2,
+                                       pipeline_model_parallel_size=2,
+                                       use_fp8=True)
+    x = jnp.arange(8.0)
+
+    def f(a):
+        return M.reduce_amax(jnp.max(jnp.abs(a)))[None]
+
+    g = shard_map(f, mesh=mesh, in_specs=P(("pp", "dp", "tp")),
+                  out_specs=P("pp"), check_vma=False)
+    out = np.asarray(g(x))
+    # per-stage (dp,tp) plane max: stage0 holds 0..3 -> 3, stage1 4..7 -> 7
+    np.testing.assert_allclose(out, [3.0, 7.0])
+    M.destroy_model_parallel()
