@@ -64,6 +64,12 @@ class GPTConfig:
     sequence_parallel: bool = False
     use_flash_attention: bool = False
     remat: bool = False            # activation checkpointing per block
+    # What the per-block checkpoint may keep (≡ the reference's partial /
+    # selective activation checkpointing, fwd_bwd_pipelining_without_
+    # interleaving.py:351-362 + tensor_parallel/random.py:237-306):
+    #   None    — save nothing, recompute the whole block (full remat)
+    #   "dots"  — save matmul (MXU) outputs, recompute elementwise only
+    remat_policy: Any = None
     axis_name: str = TP_AXIS
 
     @property
@@ -229,7 +235,15 @@ class GPT:
             bk = None if key is None else jax.random.fold_in(key, i)
             blk = lambda p, x: self._block(i, p, x, bk)
             if c.remat:
-                blk = jax.checkpoint(blk)
+                if c.remat_policy == "dots":
+                    pol = jax.checkpoint_policies.checkpoint_dots
+                    blk = jax.checkpoint(blk, policy=pol)
+                elif c.remat_policy is None:
+                    blk = jax.checkpoint(blk)
+                else:
+                    raise ValueError(
+                        f"unknown remat_policy {c.remat_policy!r}; "
+                        "expected None or 'dots'")
             h = blk(params[f"block{i}"], h)
         h = self._ln_final(params, h)
         return h

@@ -319,13 +319,16 @@ def adagrad_flat(p, h, g, lr, *, eps=1e-10, weight_decay=0.0,
 
 def _lamb_phase1_kernel(m_ref, v_ref, g_ref, p_ref, sc_ref,
                         m_out, v_out, u_out, *,
-                        beta1, beta2, eps, weight_decay, bias_correction):
+                        beta1, beta2, beta3, eps, weight_decay,
+                        bias_correction):
     """Phase 1 ≡ amp_C.multi_tensor_lamb_stage1 / lamb stage computing the
     raw update u = mhat/(sqrt(vhat)+eps) + wd*p with global-grad-norm
-    clipping fused (sc rows: [clip_ratio, bc1, bc2])."""
+    clipping fused (sc rows: [clip_ratio, bc1, bc2]).  beta3 is the grad
+    coefficient of the m update: 1-beta1 under grad averaging, else 1
+    (≡ the reference's beta3 in multi_tensor_lamb.cu)."""
     g = g_ref[...].astype(jnp.float32) * sc_ref[0, 0]
     p = p_ref[...].astype(jnp.float32)
-    m_new = beta1 * m_ref[...] + (1.0 - beta1) * g
+    m_new = beta1 * m_ref[...] + beta3 * g
     v_new = beta2 * v_ref[...] + (1.0 - beta2) * g * g
     mhat = m_new / sc_ref[1, 0] if bias_correction else m_new
     vhat = v_new / sc_ref[2, 0] if bias_correction else v_new
@@ -347,7 +350,8 @@ def _lamb_phase2_kernel(p_ref, u_ref, r_ref, sc_ref, p_out):
 
 def lamb_phase1_flat(m, v, g, p, clip_ratio, step, *, beta1, beta2, eps,
                      weight_decay, bias_correction=True,
-                     use_pallas_override=None):
+                     grad_averaging=True, use_pallas_override=None):
+    beta3 = (1.0 - beta1) if grad_averaging else 1.0
     step = jnp.asarray(step, jnp.float32)
     bc1 = 1.0 - jnp.power(jnp.float32(beta1), step)
     bc2 = 1.0 - jnp.power(jnp.float32(beta2), step)
@@ -356,7 +360,7 @@ def lamb_phase1_flat(m, v, g, p, clip_ratio, step, *, beta1, beta2, eps,
     if not use_pallas(use_pallas_override):
         g32 = g.astype(jnp.float32) * scalars[0, 0]
         p32 = p.astype(jnp.float32)
-        m_new = beta1 * m + (1 - beta1) * g32
+        m_new = beta1 * m + beta3 * g32
         v_new = beta2 * v + (1 - beta2) * g32 * g32
         mhat = m_new / bc1 if bias_correction else m_new
         vhat = v_new / bc2 if bias_correction else v_new
@@ -365,7 +369,7 @@ def lamb_phase1_flat(m, v, g, p, clip_ratio, step, *, beta1, beta2, eps,
             u = u + weight_decay * p32
         return m_new, v_new, u
     kernel = functools.partial(
-        _lamb_phase1_kernel, beta1=beta1, beta2=beta2, eps=eps,
+        _lamb_phase1_kernel, beta1=beta1, beta2=beta2, beta3=beta3, eps=eps,
         weight_decay=weight_decay, bias_correction=bias_correction)
     m2, n = _to2d(m)
     v2, _ = _to2d(v)

@@ -60,15 +60,9 @@ class DistributedFusedAdam:
         self.spec: Optional[F.FlatSpec] = None
         self.padded_total = None
 
-    def _pad(self, flat):
-        pad = (-flat.shape[0]) % self.num_shards
-        if pad:
-            flat = jnp.pad(flat, (0, pad))
-        return flat
-
     def init(self, params) -> DistributedFusedAdamState:
         self.spec = F.make_spec(params)
-        flat = self._pad(F.flatten(params, jnp.float32))
+        flat = F.flatten(params, jnp.float32, pad_to=self.num_shards)
         self.padded_total = flat.shape[0]
         shard_size = self.padded_total // self.num_shards
         rank = lax.axis_index(self.axis_name)
@@ -84,7 +78,7 @@ class DistributedFusedAdam:
         Returns (full params pytree, new state).  The reduce-scatter
         averages over dp (≡ the reference's grad sync divide)."""
         ax = self.axis_name
-        g_flat = self._pad(F.flatten(grads, jnp.float32))
+        g_flat = F.flatten(grads, jnp.float32, pad_to=self.num_shards)
         # ZeRO-2 core: one reduce-scatter replaces DDP's allreduce
         g_shard = lax.psum_scatter(g_flat, ax, scatter_dimension=0,
                                    tiled=True) / self.num_shards
@@ -135,13 +129,9 @@ class DistributedFusedLAMB:
         self.spec = None
         self.padded_total = None
 
-    def _pad(self, flat):
-        pad = (-flat.shape[0]) % self.num_shards
-        return jnp.pad(flat, (0, pad)) if pad else flat
-
     def init(self, params):
         self.spec = F.make_spec(params)
-        flat = self._pad(F.flatten(params, jnp.float32))
+        flat = F.flatten(params, jnp.float32, pad_to=self.num_shards)
         self.padded_total = flat.shape[0]
         shard_size = self.padded_total // self.num_shards
         rank = lax.axis_index(self.axis_name)
@@ -153,7 +143,7 @@ class DistributedFusedLAMB:
 
     def step(self, state, grads, lr=None, inv_scale=1.0, found_inf=False):
         ax = self.axis_name
-        g_flat = self._pad(F.flatten(grads, jnp.float32)) * jnp.asarray(
+        g_flat = F.flatten(grads, jnp.float32, pad_to=self.num_shards) * jnp.asarray(
             inv_scale, jnp.float32)
         g_shard = lax.psum_scatter(g_flat, ax, scatter_dimension=0,
                                    tiled=True) / self.num_shards
@@ -185,8 +175,7 @@ class DistributedFusedLAMB:
         un = K.per_tensor_l2norm(full_u[: self.spec.total], sizes)
         ratio = jnp.where((wn > 0) & (un > 0), wn / jnp.maximum(un, 1e-12),
                           1.0)
-        ratio_elem = K.expand_per_tensor(ratio, sizes, self.spec.total)
-        ratio_elem = self._pad(ratio_elem)
+        ratio_elem = K.expand_per_tensor(ratio, sizes, self.padded_total)
         shard_size = self.padded_total // self.num_shards
         rank = lax.axis_index(ax)
         ratio_shard = lax.dynamic_slice(ratio_elem, (rank * shard_size,),

@@ -70,15 +70,13 @@ class FusedLAMB:
                              self.max_grad_norm / gnorm, 1.0)
         else:
             clip = jnp.float32(1.0)
-        beta1 = self.beta1
-        grad_scale = clip * (1.0 if self.grad_averaging else 1.0)
-
         m, v, u = K.lamb_phase1_flat(
             state.exp_avg, state.exp_avg_sq, g_flat, state.params,
-            clip_ratio=grad_scale, step=step_next.astype(jnp.float32),
-            beta1=beta1, beta2=self.beta2, eps=self.eps,
+            clip_ratio=clip, step=step_next.astype(jnp.float32),
+            beta1=self.beta1, beta2=self.beta2, eps=self.eps,
             weight_decay=self.weight_decay,
             bias_correction=self.bias_correction,
+            grad_averaging=self.grad_averaging,
             use_pallas_override=self.use_pallas)
 
         # per-tensor trust ratios ≡ the lamb kernel's

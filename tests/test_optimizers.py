@@ -188,3 +188,23 @@ def test_fused_novograd_smoke():
     assert int(state.step) == 3
     assert all(np.isfinite(np.asarray(l)).all()
                for l in jax.tree_util.tree_leaves(params))
+
+
+def test_fused_lamb_grad_averaging_off():
+    """grad_averaging=False must use beta3=1 in the m update
+    (≡ the beta3 coefficient of multi_tensor_lamb.cu): with beta1=0.9
+    the first-step momentum is 10x larger than the averaged variant."""
+    params = _params(jax.random.PRNGKey(9))
+    grads = _grads(jax.random.PRNGKey(10), params)
+    kw = dict(lr=1e-3, betas=(0.9, 0.999), max_grad_norm=0.0,
+              use_pallas=True)
+    opt_avg = FusedLAMB(grad_averaging=True, **kw)
+    opt_raw = FusedLAMB(grad_averaging=False, **kw)
+    s_avg = opt_avg.init(params)
+    s_raw = opt_raw.init(params)
+    _, s_avg = opt_avg.step(s_avg, grads)
+    _, s_raw = opt_raw.step(s_raw, grads)
+    n = opt_avg.spec.total
+    np.testing.assert_allclose(np.asarray(s_raw.exp_avg[:n]),
+                               np.asarray(s_avg.exp_avg[:n]) * 10.0,
+                               rtol=1e-5)
