@@ -195,3 +195,13 @@ def scaled_upper_triang_masked_softmax(x, scale: float = 1.0,
     if use_pallas(use_pallas_override):
         return _softmax(x, None, float(scale), True)
     return scaled_upper_triang_masked_softmax_reference(x, scale)
+
+
+def get_batch_per_block(sq: int, sk: int, batches: int, attn_heads: int) -> int:
+    """Scheduling hint ≡ scaled_masked_softmax_cuda.get_batch_per_block
+    (csrc/megatron/scaled_masked_softmax.cpp): how many (batch, head)
+    rows one kernel block covers.  The Pallas kernel tiles rows in
+    row-block groups over the flattened (batches*heads*sq) dimension,
+    so the answer is rows-per-block / sq (at least 1)."""
+    rows = batches * attn_heads * sq
+    return max(1, row_block(rows, sk) // max(sq, 1))

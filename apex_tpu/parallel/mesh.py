@@ -388,3 +388,22 @@ def get_model_parallel_axes() -> tuple:
     """Axes of the model-parallel group (pp × tp plane) — e.g. for the
     MP-aware GradScaler's found_inf reduction (amp/grad_scaler.py:44-55)."""
     return (PP_AXIS, TP_AXIS)
+
+
+def new_process_group(axes) -> tuple:
+    """≡ parallel_state.new_process_group (parallel_state.py:108-153).
+
+    The reference creates a torch.distributed group from a rank list,
+    choosing NCCL-vs-UCC and IB/socket transports.  Under one SPMD mesh a
+    "group" is just a validated tuple of mesh axis names to hand to a
+    collective; transport selection is XLA's (ICI within a slice, DCN
+    across).  Accepts a single axis name or an iterable of them.
+    """
+    if isinstance(axes, str):
+        axes = (axes,)
+    axes = tuple(axes)
+    valid = set(get_mesh().axis_names)
+    unknown = [a for a in axes if a not in valid]
+    if unknown:
+        raise ValueError(f"unknown mesh axes {unknown}; have {sorted(valid)}")
+    return axes

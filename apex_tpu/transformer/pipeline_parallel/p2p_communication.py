@@ -46,3 +46,18 @@ def send_forward_backward_recv_forward_backward(x, g,
                                                 axis_name: str = PP_AXIS):
     """≡ p2p_communication.py:571-690 (the fused steady-state 1F1B op)."""
     return _shift(x, axis_name, +1), _shift(g, axis_name, -1)
+
+
+class FutureTensor:
+    """≡ p2p_communication.FutureTensor (p2p_communication.py:34-45): the
+    reference pairs a tensor with an outstanding NCCL request to overlap
+    communication with compute.  XLA arrays are ALREADY futures (async
+    dispatch): `get()` is just a block-until-ready, kept so schedule code
+    written against the reference API ports over unchanged."""
+
+    def __init__(self, tensor):
+        self.tensor = tensor
+
+    def get(self):
+        t = self.tensor
+        return t.block_until_ready() if hasattr(t, "block_until_ready") else t

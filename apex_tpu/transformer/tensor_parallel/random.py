@@ -106,3 +106,54 @@ def split_tensor_into_1d_equal_chunks(x, axis_name: str = TP_AXIS):
 def gather_split_1d_tensor(chunk, axis_name: str = TP_AXIS):
     """Inverse gather ≡ random.py:75-83."""
     return lax.all_gather(chunk, axis_name, axis=0, tiled=True)
+
+
+# --- distributed (tp-sharded) checkpointed-activation storage ---------------
+#
+# ≡ init_checkpointed_activations_memory_buffer + the
+# distribute_saved_activations branch of CheckpointFunction
+# (random.py:48-83, 237-306): the reference carves recomputation inputs
+# into a preallocated buffer sharded over tp.  Functionally in JAX:
+# shard the saved residuals over tp between fwd and bwd via a
+# split/all-gather custom pair; XLA owns the allocation, so the "memory
+# buffer" reduces to the sharding transform itself.
+
+def checkpoint_with_distributed_saved_activations(fn, axis_name: str = TP_AXIS):
+    """Returns g(x, *args) ≡ checkpoint(fn)(x, *args) where the stored
+    residual is the tp-shard of `x` (1/tp_size the memory); the full `x`
+    is all-gathered back only when the backward pass recomputes.
+
+    jax.checkpoint saves exactly the *inputs* of the wrapped function,
+    so the shard/gather pair is placed across that boundary: the
+    checkpointed function receives the small chunk (saved), and
+    reconstructs `x` inside (recomputed in bwd).
+    """
+
+    from apex_tpu.parallel.collectives import (
+        gather_from_sequence_parallel_region_no_tp_grad,
+        scatter_to_sequence_parallel_region,
+    )
+
+    def g(x, *args):
+        # split fwd / all-gather bwd outside; gather fwd / split bwd
+        # inside — the Megatron pair keeps replicated activation grads
+        # exact (a raw slice+all_gather would zero or tp-multiply dx)
+        chunk = scatter_to_sequence_parallel_region(
+            x.reshape(-1, 1), axis_name)
+        shape, dtype = x.shape, x.dtype
+
+        def inner(ck, *a):
+            full = gather_from_sequence_parallel_region_no_tp_grad(
+                ck, axis_name)
+            return fn(full.reshape(shape).astype(dtype), *a)
+
+        return jax.checkpoint(inner)(chunk, *args)
+
+    return g
+
+
+def init_checkpointed_activations_memory_buffer(*_args, **_kw):
+    """≡ random.py:48-83.  No-op on TPU: XLA preallocates and reuses
+    activation memory; the distributed-storage behavior lives in
+    checkpoint_with_distributed_saved_activations."""
+    return None

@@ -153,3 +153,72 @@ def test_timers_and_commons_and_args():
     assert args.tensor_model_parallel_size == 1
     assert args.hidden_size == 64
     assert args.kv_channels == 16
+
+
+def test_get_batch_per_block():
+    from apex_tpu.ops.softmax import get_batch_per_block
+    # parity shim for scaled_masked_softmax_cuda.get_batch_per_block
+    assert get_batch_per_block(128, 128, 4, 8) >= 1
+    assert isinstance(get_batch_per_block(2048, 2048, 1, 1), int)
+
+
+def test_future_tensor():
+    import jax.numpy as jnp
+    from apex_tpu.transformer.pipeline_parallel.p2p_communication import (
+        FutureTensor)
+    f = FutureTensor(jnp.arange(3.0))
+    assert float(f.get()[1]) == 1.0
+    assert f.tensor.shape == (3,)
+
+
+def test_new_process_group_axes():
+    import pytest
+    from apex_tpu.parallel import mesh as M
+    M.destroy_model_parallel()
+    M.initialize_model_parallel(tensor_model_parallel_size=2)
+    assert M.new_process_group("tp") == ("tp",)
+    assert M.new_process_group(["dp", "tp"]) == ("dp", "tp")
+    with pytest.raises(ValueError):
+        M.new_process_group("ep")
+    M.destroy_model_parallel()
+
+
+def test_distributed_saved_activation_checkpoint_grads():
+    """The tp-sharded residual checkpoint must be gradient-exact vs the
+    plain function (≡ CheckpointFunction distribute_saved_activations,
+    random.py:237-306)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+    from apex_tpu.parallel import mesh as M
+    from apex_tpu.transformer.tensor_parallel.random import (
+        checkpoint_with_distributed_saved_activations)
+
+    M.destroy_model_parallel()
+    mesh = M.initialize_model_parallel(tensor_model_parallel_size=8)
+    w = jax.random.normal(jax.random.PRNGKey(0), (16, 16))
+    x = jax.random.normal(jax.random.PRNGKey(1), (16, 16))
+
+    def fn(x, w):
+        return jnp.sum(jnp.tanh(x @ w) ** 2)
+
+    ck = checkpoint_with_distributed_saved_activations(fn)
+
+    def loss_plain(x, w):
+        return fn(x, w)
+
+    def loss_ck(x, w):
+        return ck(x, w)
+
+    gp = shard_map(jax.grad(loss_plain, argnums=(0, 1)), mesh=mesh,
+                   in_specs=(P(), P()), out_specs=(P(), P()),
+                   check_vma=False)(x, w)
+    gc = shard_map(jax.grad(loss_ck, argnums=(0, 1)), mesh=mesh,
+                   in_specs=(P(), P()), out_specs=(P(), P()),
+                   check_vma=False)(x, w)
+    for a, b in zip(gp, gc):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
+    M.destroy_model_parallel()
