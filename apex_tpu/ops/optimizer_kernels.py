@@ -85,8 +85,8 @@ def _adam_kernel(p_ref, m_ref, v_ref, g_ref, sc_ref,
     """sc_ref rows: [lr, inv_scale, found_inf, bc1, bc2] broadcast scalars."""
     g = g_ref[...].astype(jnp.float32)
     p = p_ref[...].astype(jnp.float32)
-    m = m_ref[...]
-    v = v_ref[...]
+    m = m_ref[...].astype(jnp.float32)
+    v = v_ref[...].astype(jnp.float32)
     lr = sc_ref[0, 0]
     inv_scale = sc_ref[1, 0]
     found_inf = sc_ref[2, 0]
@@ -108,8 +108,8 @@ def _adam_kernel(p_ref, m_ref, v_ref, g_ref, sc_ref,
     p_new = p - lr * update
     keep = found_inf > 0.5
     p_out[...] = jnp.where(keep, p, p_new).astype(p_out.dtype)
-    m_out[...] = jnp.where(keep, m, m_new)
-    v_out[...] = jnp.where(keep, v, v_new)
+    m_out[...] = jnp.where(keep, m, m_new).astype(m_out.dtype)
+    v_out[...] = jnp.where(keep, v, v_new).astype(v_out.dtype)
 
 
 def adam_flat(p, m, v, g, lr, step, *, beta1=0.9, beta2=0.999, eps=1e-8,
@@ -152,8 +152,8 @@ def adam_flat(p, m, v, g, lr, step, *, beta1=0.9, beta2=0.999, eps=1e-8,
         in_specs=[spec, spec, spec, spec, sspec],
         out_specs=[spec, spec, spec],
         out_shape=[jax.ShapeDtypeStruct(p2.shape, p2.dtype),
-                   jax.ShapeDtypeStruct(m2.shape, jnp.float32),
-                   jax.ShapeDtypeStruct(v2.shape, jnp.float32)],
+                   jax.ShapeDtypeStruct(m2.shape, m2.dtype),
+                   jax.ShapeDtypeStruct(v2.shape, v2.dtype)],
         input_output_aliases={0: 0, 1: 1, 2: 2},
         interpret=pallas_interpret(),
     )(p2, m2, v2, g2, scalars)
@@ -167,8 +167,10 @@ def _adam_reference(p, m, v, g, scalars, beta1, beta2, eps, weight_decay,
     p32 = p.astype(jnp.float32)
     if not adam_w_mode and weight_decay:
         g = g + weight_decay * p32
-    m_new = beta1 * m + (1 - beta1) * g
-    v_new = beta2 * v + (1 - beta2) * g * g
+    m32 = m.astype(jnp.float32)
+    v32 = v.astype(jnp.float32)
+    m_new = beta1 * m32 + (1 - beta1) * g
+    v_new = beta2 * v32 + (1 - beta2) * g * g
     mhat = m_new / bc1 if bias_correction else m_new
     vhat = v_new / bc2 if bias_correction else v_new
     update = mhat / (jnp.sqrt(vhat) + eps)
@@ -177,7 +179,8 @@ def _adam_reference(p, m, v, g, scalars, beta1, beta2, eps, weight_decay,
     p_new = p32 - lr * update
     keep = found_inf > 0.5
     return (jnp.where(keep, p32, p_new).astype(p.dtype),
-            jnp.where(keep, m, m_new), jnp.where(keep, v, v_new))
+            jnp.where(keep, m32, m_new).astype(m.dtype),
+            jnp.where(keep, v32, v_new).astype(v.dtype))
 
 
 # ------------------------------- SGD ----------------------------------------
@@ -185,12 +188,18 @@ def _adam_reference(p, m, v, g, scalars, beta1, beta2, eps, weight_decay,
 def _sgd_kernel(p_ref, b_ref, g_ref, sc_ref, p_out, b_out, *,
                 momentum, dampening, nesterov, weight_decay,
                 wd_after_momentum, first_run):
+    """sc rows: [lr, inv_scale, found_inf, first].  `first` selects the
+    buf:=g initialization (torch's buf-is-None branch) IN-kernel so one
+    aliased pass covers step 0 and steady state — a host-side where on
+    the buffer would materialize a copy and break in-place aliasing.
+    `first_run=True` forces the init branch statically."""
     g = g_ref[...].astype(jnp.float32)
     p = p_ref[...].astype(jnp.float32)
-    b = b_ref[...]
+    b = b_ref[...].astype(jnp.float32)
     lr = sc_ref[0, 0]
     inv_scale = sc_ref[1, 0]
     found_inf = sc_ref[2, 0]
+    first = sc_ref[3, 0] > 0.5
     g = g * inv_scale
     if weight_decay != 0.0 and not wd_after_momentum:
         g = g + weight_decay * p
@@ -198,7 +207,8 @@ def _sgd_kernel(p_ref, b_ref, g_ref, sc_ref, p_out, b_out, *,
         if first_run:
             b_new = g
         else:
-            b_new = momentum * b + (1.0 - dampening) * g
+            b_steady = momentum * b + (1.0 - dampening) * g
+            b_new = jnp.where(first, g, b_steady)
         upd = g + momentum * b_new if nesterov else b_new
     else:
         b_new = b
@@ -208,19 +218,22 @@ def _sgd_kernel(p_ref, b_ref, g_ref, sc_ref, p_out, b_out, *,
     p_new = p - lr * upd
     keep = found_inf > 0.5
     p_out[...] = jnp.where(keep, p, p_new).astype(p_out.dtype)
-    b_out[...] = jnp.where(keep, b, b_new)
+    b_out[...] = jnp.where(keep, b, b_new).astype(b_out.dtype)
 
 
 def sgd_flat(p, buf, g, lr, *, momentum=0.0, dampening=0.0, nesterov=False,
              weight_decay=0.0, wd_after_momentum=False, first_run=False,
-             inv_scale=1.0, found_inf=False, use_pallas_override=None):
+             first=False, inv_scale=1.0, found_inf=False,
+             use_pallas_override=None):
     """≡ amp_C.multi_tensor_sgd (csrc/multi_tensor_sgd_kernel.cu).
-    Returns (p, momentum_buffer)."""
+    Returns (p, momentum_buffer).  `first` (traced bool) selects the
+    buf:=g first-step branch in-kernel; `first_run` is its static form."""
     scalars = jnp.stack([
         jnp.asarray(lr, jnp.float32),
         jnp.asarray(inv_scale, jnp.float32),
         jnp.asarray(found_inf, jnp.float32),
-    ]).reshape(3, 1)
+        jnp.asarray(first, jnp.float32),
+    ]).reshape(4, 1)
     if not use_pallas(use_pallas_override):
         # jnp fallback mirrors the kernel exactly
         g32 = g.astype(jnp.float32) * scalars[1, 0]
@@ -228,7 +241,12 @@ def sgd_flat(p, buf, g, lr, *, momentum=0.0, dampening=0.0, nesterov=False,
         if weight_decay and not wd_after_momentum:
             g32 = g32 + weight_decay * p32
         if momentum != 0.0:
-            b_new = g32 if first_run else momentum * buf + (1 - dampening) * g32
+            if first_run:
+                b_new = g32
+            else:
+                b_new = jnp.where(scalars[3, 0] > 0.5, g32,
+                                  momentum * buf.astype(jnp.float32)
+                                  + (1 - dampening) * g32)
             upd = g32 + momentum * b_new if nesterov else b_new
         else:
             b_new, upd = buf, g32
@@ -236,8 +254,10 @@ def sgd_flat(p, buf, g, lr, *, momentum=0.0, dampening=0.0, nesterov=False,
             upd = upd + weight_decay * p32
         p_new = p32 - scalars[0, 0] * upd
         keep = scalars[2, 0] > 0.5
+        b32 = buf.astype(jnp.float32)
+        b_new = b_new.astype(jnp.float32)
         return (jnp.where(keep, p32, p_new).astype(p.dtype),
-                jnp.where(keep, buf, b_new))
+                jnp.where(keep, b32, b_new).astype(buf.dtype))
     kernel = functools.partial(
         _sgd_kernel, momentum=momentum, dampening=dampening,
         nesterov=nesterov, weight_decay=weight_decay,
@@ -247,14 +267,14 @@ def sgd_flat(p, buf, g, lr, *, momentum=0.0, dampening=0.0, nesterov=False,
     g2, _ = _to2d(g)
     grid = p2.shape[0] // _BLOCK_ROWS
     spec = pl.BlockSpec((_BLOCK_ROWS, _LANES), lambda i: (i, 0))
-    sspec = pl.BlockSpec((3, 1), lambda i: (0, 0))
+    sspec = pl.BlockSpec((4, 1), lambda i: (0, 0))
     pn, bn = pl.pallas_call(
         kernel,
         grid=(grid,),
         in_specs=[spec, spec, spec, sspec],
         out_specs=[spec, spec],
         out_shape=[jax.ShapeDtypeStruct(p2.shape, p2.dtype),
-                   jax.ShapeDtypeStruct(b2.shape, jnp.float32)],
+                   jax.ShapeDtypeStruct(b2.shape, b2.dtype)],
         input_output_aliases={0: 0, 1: 1},
         interpret=pallas_interpret(),
     )(p2, b2, g2, scalars)

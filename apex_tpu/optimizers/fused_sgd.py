@@ -8,6 +8,7 @@ from __future__ import annotations
 
 from typing import NamedTuple, Optional
 
+import jax
 import jax.numpy as jnp
 
 from apex_tpu.ops import optimizer_kernels as K
@@ -24,7 +25,8 @@ class FusedSGD:
     def __init__(self, lr=1e-3, momentum=0.0, dampening=0.0,
                  weight_decay=0.0, nesterov=False,
                  wd_after_momentum=False,
-                 use_pallas: Optional[bool] = None):
+                 use_pallas: Optional[bool] = None,
+                 master_dtype=jnp.float32):
         if nesterov and (momentum <= 0 or dampening != 0):
             raise ValueError(
                 "Nesterov momentum requires a momentum and zero dampening")
@@ -34,44 +36,44 @@ class FusedSGD:
         self.weight_decay = weight_decay
         self.nesterov = nesterov
         self.wd_after_momentum = wd_after_momentum
+        self.master_dtype = master_dtype
         self.use_pallas = use_pallas
         self.spec = None
 
     def init(self, params) -> FusedSGDState:
         self.spec = F.make_spec(params)
-        flat = F.flatten(params, jnp.float32, pad_to=K.FLAT_TILE)
+        flat = F.flatten(params, self.master_dtype, pad_to=K.FLAT_TILE)
         return FusedSGDState(step=jnp.zeros((), jnp.int32), params=flat,
                              momentum_buffer=jnp.zeros_like(flat))
 
     def step(self, state: FusedSGDState, grads, lr=None, inv_scale=1.0,
              found_inf=False):
-        g_flat = F.flatten(grads, jnp.float32, pad_to=K.FLAT_TILE)
+        gdts = {l.dtype for l in jax.tree_util.tree_leaves(grads)}
+        gdt = gdts.pop() if len(gdts) == 1 else jnp.float32
+        g_flat = F.flatten(grads, gdt, pad_to=K.FLAT_TILE)
+        return self.step_flat(state, g_flat, lr=lr, inv_scale=inv_scale,
+                              found_inf=found_inf)
+
+    def step_flat(self, state: FusedSGDState, g_flat, lr=None,
+                  inv_scale=1.0, found_inf=False):
+        """Step from an already-flat grad buffer (zero-copy hot path)."""
         found = jnp.asarray(found_inf)
-        # first_run initializes the momentum buffer with the raw grad
-        # (≡ torch SGD buf-is-None branch); branch-free via buffer math:
-        # step==0 → buf := g is equivalent to momentum*0 + (1-damp)*g only
-        # when dampening==0, so emulate with a traced select on step.
+        # first-step semantics (buf := g, torch's buf-is-None branch) are
+        # a traced scalar select INSIDE the kernel: a host-side transform
+        # of the buffer materializes a param-sized copy and breaks the
+        # in-place aliasing chain, and lax.cond of two kernel calls does
+        # the same — this also keeps a skipped (found_inf) first step
+        # from writing any derived value into the buffer.
         first = state.step == 0
         if self.momentum != 0.0:
-            # compute both branches, select (cheap: one extra elementwise)
-            p1, b1 = K.sgd_flat(
-                state.params, state.momentum_buffer, g_flat,
-                lr=self.lr if lr is None else lr, momentum=self.momentum,
-                dampening=self.dampening, nesterov=self.nesterov,
-                weight_decay=self.weight_decay,
-                wd_after_momentum=self.wd_after_momentum, first_run=True,
-                inv_scale=inv_scale, found_inf=found,
-                use_pallas_override=self.use_pallas)
-            p2, b2 = K.sgd_flat(
+            p, buf = K.sgd_flat(
                 state.params, state.momentum_buffer, g_flat,
                 lr=self.lr if lr is None else lr, momentum=self.momentum,
                 dampening=self.dampening, nesterov=self.nesterov,
                 weight_decay=self.weight_decay,
                 wd_after_momentum=self.wd_after_momentum, first_run=False,
-                inv_scale=inv_scale, found_inf=found,
+                first=first, inv_scale=inv_scale, found_inf=found,
                 use_pallas_override=self.use_pallas)
-            p = jnp.where(first, p1, p2)
-            buf = jnp.where(first, b1, b2)
         else:
             p, buf = K.sgd_flat(
                 state.params, state.momentum_buffer, g_flat,

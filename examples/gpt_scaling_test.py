@@ -43,10 +43,18 @@ def main():
     p.add_argument("--seq-len", type=int, default=512)
     p.add_argument("--tp", type=int, default=1)
     p.add_argument("--max-hidden", type=int, default=2048)
+    p.add_argument("--min-hidden", type=int, default=0)
+    p.add_argument("--optimizer", choices=["adam", "sgd"], default="adam")
+    p.add_argument("--pure-half", action="store_true",
+                   help="O3-style bf16 optimizer state (master_dtype="
+                        "bfloat16): p+m+v at 6 B/param lets the 1.3B "
+                        "point train on a single 16GB chip")
+    p.add_argument("--donate", action=argparse.BooleanOptionalAction,
+                   default=True)
     args = p.parse_args()
 
     for hidden, layers, heads in SWEEP:
-        if hidden > args.max_hidden:
+        if hidden > args.max_hidden or hidden < args.min_hidden:
             continue
         M.destroy_model_parallel()
         mesh = M.initialize_model_parallel(
@@ -59,9 +67,15 @@ def main():
         model = GPT(cfg)
         params = model.init(jax.random.PRNGKey(0))
         n_params = sum(x.size for x in jax.tree_util.tree_leaves(params))
-        opt = FusedAdam(lr=1e-4)
+        mdt = jnp.bfloat16 if args.pure_half else jnp.float32
+        if args.optimizer == "sgd":
+            from apex_tpu.optimizers.fused_sgd import FusedSGD
+            opt = FusedSGD(lr=1e-3, momentum=0.9, master_dtype=mdt)
+        else:
+            opt = FusedAdam(lr=1e-4, master_dtype=mdt)
         opt_state = init_sharded_optimizer(opt, model, params, mesh)
-        step = make_tp_dp_train_step(model, opt, mesh, donate=False)
+        step = make_tp_dp_train_step(model, opt, mesh, donate=args.donate)
+        del params  # the donated flat state owns the master copy
         tokens = jax.random.randint(
             jax.random.PRNGKey(1), (args.batch_size, args.seq_len), 0,
             cfg.vocab_size)
