@@ -67,7 +67,7 @@ def _matmul_kernel(x_ref, w_ref, b_ref, o_ref, acc_ref, *, activation,
     def _epilogue():
         y = acc_ref[...]
         if has_bias:
-            y = y + b_ref[...].astype(jnp.float32)
+            y = y + b_ref[0, :].astype(jnp.float32)
         o_ref[...] = _act(y, activation).astype(o_ref.dtype)
 
 
@@ -81,8 +81,11 @@ def _matmul_pallas(x2, w, b, activation, bm=256, bn=256, bk=512):
     xp = jnp.pad(x2, ((0, mp - m), (0, kp - kdim))) if (mp, kp) != (m, kdim) else x2
     wp = jnp.pad(w, ((0, kp - kdim), (0, np_ - n))) if (kp, np_) != (kdim, n) else w
     has_bias = b is not None
+    # bias rides as a (1, n) row: TPU Mosaic requires >=2-D blocks with a
+    # 128-lane minor dim (a 1-D spec compiles in interpret mode only)
     bp = jnp.pad(b, (0, np_ - n)) if has_bias and np_ != n else (
         b if has_bias else jnp.zeros((np_,), x2.dtype))
+    bp = bp.reshape(1, np_)
     k_steps = kp // bk
     out = pl.pallas_call(
         functools.partial(_matmul_kernel, activation=activation,
@@ -91,7 +94,7 @@ def _matmul_pallas(x2, w, b, activation, bm=256, bn=256, bk=512):
         in_specs=[
             pl.BlockSpec((bm, bk), lambda i, j, k: (i, k)),
             pl.BlockSpec((bk, bn), lambda i, j, k: (k, j)),
-            pl.BlockSpec((bn,), lambda i, j, k: (j,)),
+            pl.BlockSpec((1, bn), lambda i, j, k: (0, j)),
         ],
         out_specs=pl.BlockSpec((bm, bn), lambda i, j, k: (i, j)),
         out_shape=jax.ShapeDtypeStruct((mp, np_), x2.dtype),
