@@ -77,9 +77,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
 
     @pl.when(run)
     def _step():
-        q = q_ref[0].astype(jnp.float32)          # (bq, d)
-        k = k_ref[0].astype(jnp.float32)          # (bk, d)
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+        # native-dtype operands: MXU wants bf16 x bf16 -> fp32; a
+        # pre-upcast to fp32 would push the matmul off the MXU
+        s = jax.lax.dot_general(q_ref[0], k_ref[0],
+                                (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         if causal:
             rows = j * bq + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
@@ -119,17 +120,15 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(run)
     def _step():
-        q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+        s = jax.lax.dot_general(q_ref[0], k_ref[0],
+                                (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         if causal:
             rows = j * bq + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
             cols = t * bk + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
             s = jnp.where(cols > rows, _NEG_INF, s)
         p = jnp.exp(s - lse_ref[0])
-        do = do_ref[0].astype(jnp.float32)
-        dp = jax.lax.dot_general(do, v_ref[0].astype(jnp.float32),
+        dp = jax.lax.dot_general(do_ref[0], v_ref[0],
                                  (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         ds = p * (dp - delta_ref[0])
@@ -159,25 +158,23 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(run)
     def _step():
-        q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+        s = jax.lax.dot_general(q_ref[0], k_ref[0],
+                                (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         if causal:
             rows = j * bq + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
             cols = t * bk + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
             s = jnp.where(cols > rows, _NEG_INF, s)
         p = jnp.exp(s - lse_ref[0])                     # (bq, bk)
-        do = do_ref[0].astype(jnp.float32)              # (bq, d)
         dv_scr[...] += jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())),
+            p.astype(do_ref.dtype), do_ref[0], (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)         # (bk, d)
-        dp = jax.lax.dot_general(do, v_ref[0].astype(jnp.float32),
+        dp = jax.lax.dot_general(do_ref[0], v_ref[0],
                                  (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         ds = p * (dp - delta_ref[0])                    # (bq, bk)
         dk_scr[...] += scale * jax.lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())),
+            ds.astype(q_ref.dtype), q_ref[0], (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)         # (bk, d)
 
     @pl.when(j == nq - 1)

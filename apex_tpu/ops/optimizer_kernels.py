@@ -467,3 +467,48 @@ def scale_flat(flat, scale):
 def axpby_flat(a, x, b, y):
     """≡ amp_C.multi_tensor_axpby: a*x + b*y."""
     return a * x.astype(jnp.float32) + b * y.astype(jnp.float32)
+
+
+# --- row-aligned per-tensor reductions (128-lane-aligned FlatSpec) ----------
+#
+# With FlatSpec(align=_LANES) every tensor's segment spans whole rows of
+# the (rows, 128) 2-D view (zero-filled tails), so multi_tensor_l2norm's
+# per_tensor mode becomes: one squared-row-sum pass + one static
+# segment-sum — instead of one dynamic_slice+reduction per tensor (which
+# at BERT/GPT scale is ~600 serialized slices over the whole buffer).
+
+def _row_segment_ids(spec):
+    import numpy as _np
+    # segment extents come straight from the spec's (aligned) offsets so
+    # this can never drift from make_spec's padding rule
+    bounds = list(spec.offsets) + [spec.total]
+    rows = [(bounds[i + 1] - bounds[i]) // _LANES
+            for i in range(len(spec.offsets))]
+    return _np.repeat(_np.arange(len(rows), dtype=_np.int32), rows)
+
+
+def per_tensor_l2norm_aligned(flat, spec):
+    """Per-tensor L2 norms over a lane-aligned flat buffer; `spec.align`
+    must be a multiple of the 128-lane width."""
+    assert spec.align % _LANES == 0, "spec must be lane-aligned"
+    x2 = flat[: spec.total].reshape(-1, _LANES).astype(jnp.float32)
+    rowsq = jnp.sum(x2 * x2, axis=1)                      # (rows,)
+    seg = jnp.asarray(_row_segment_ids(spec))             # static constant
+    sums = jax.ops.segment_sum(rowsq, seg,
+                               num_segments=len(spec.sizes))
+    return jnp.sqrt(sums)
+
+
+def expand_per_tensor_aligned(values, spec, total):
+    """Broadcast per-tensor scalars to a per-element vector of `total`
+    length (>= spec.total; the tail repeats the last value, harmless on
+    zero padding)."""
+    assert spec.align % _LANES == 0
+    seg = jnp.asarray(_row_segment_ids(spec))
+    per_row = values[seg]                                  # (rows,)
+    elem = jnp.broadcast_to(per_row[:, None],
+                            (per_row.shape[0], _LANES)).reshape(-1)
+    if total > elem.shape[0]:
+        elem = jnp.concatenate(
+            [elem, jnp.broadcast_to(values[-1], (total - elem.shape[0],))])
+    return elem

@@ -102,9 +102,12 @@ class FusedAdam:
     # --- checkpoint parity ≡ torch optimizer state_dict -------------------
     def state_dict(self, state: FusedAdamState) -> dict:
         return {"step": state.step, "params": state.params,
-                "exp_avg": state.exp_avg, "exp_avg_sq": state.exp_avg_sq}
+                "exp_avg": state.exp_avg, "exp_avg_sq": state.exp_avg_sq,
+                "flat_layout": F.layout_dict(self.spec)}
 
     def load_state_dict(self, d: dict) -> FusedAdamState:
+        if self.spec is not None:
+            F.check_layout(self.spec, d, "FusedAdam")
         return FusedAdamState(step=jnp.asarray(d["step"], jnp.int32),
                               params=jnp.asarray(d["params"]),
                               exp_avg=jnp.asarray(d["exp_avg"]),

@@ -48,15 +48,17 @@ class FusedLAMB:
         self.spec = None
 
     def init(self, params) -> FusedLAMBState:
-        self.spec = F.make_spec(params)
-        flat = F.flatten(params, jnp.float32, pad_to=K.FLAT_TILE)
+        self.spec = F.make_spec(params, align=K._LANES)
+        flat = F.flatten(params, jnp.float32, pad_to=K.FLAT_TILE,
+                         align=K._LANES)
         zeros = jnp.zeros_like(flat)
         return FusedLAMBState(step=jnp.zeros((), jnp.int32), params=flat,
                               exp_avg=zeros, exp_avg_sq=zeros)
 
     def step(self, state: FusedLAMBState, grads, lr=None, inv_scale=1.0,
              found_inf=False):
-        g_flat = F.flatten(grads, jnp.float32, pad_to=K.FLAT_TILE) * jnp.asarray(
+        g_flat = F.flatten(grads, jnp.float32, pad_to=K.FLAT_TILE,
+                           align=K._LANES) * jnp.asarray(
             inv_scale, jnp.float32)
         found = jnp.asarray(found_inf)
         step_next = state.step + jnp.where(found, 0, 1).astype(jnp.int32)
@@ -81,12 +83,12 @@ class FusedLAMB:
 
         # per-tensor trust ratios ≡ the lamb kernel's
         # ratio = w_norm / u_norm when both > 0 else 1
-        sizes = self.spec.sizes
-        wn = K.per_tensor_l2norm(state.params, sizes)
-        un = K.per_tensor_l2norm(u, sizes)
+        wn = K.per_tensor_l2norm_aligned(state.params, self.spec)
+        un = K.per_tensor_l2norm_aligned(u, self.spec)
         ratio = jnp.where((wn > 0) & (un > 0), wn / jnp.maximum(un, 1e-12),
                           1.0)
-        ratio_elem = K.expand_per_tensor(ratio, sizes, state.params.shape[0])
+        ratio_elem = K.expand_per_tensor_aligned(ratio, self.spec,
+                                                 state.params.shape[0])
 
         p_new = K.lamb_phase2_flat(state.params, u, ratio_elem, lr_val,
                                    use_pallas_override=self.use_pallas)
@@ -97,6 +99,20 @@ class FusedLAMB:
         new_state = FusedLAMBState(step=step_next, params=p, exp_avg=m,
                                    exp_avg_sq=v)
         return F.unflatten(p, self.spec), new_state
+
+    # --- checkpoint parity -------------------------------------------------
+    def state_dict(self, state: FusedLAMBState) -> dict:
+        return {"step": state.step, "params": state.params,
+                "exp_avg": state.exp_avg, "exp_avg_sq": state.exp_avg_sq,
+                "flat_layout": F.layout_dict(self.spec)}
+
+    def load_state_dict(self, d: dict) -> FusedLAMBState:
+        if self.spec is not None:
+            F.check_layout(self.spec, d, "FusedLAMB")
+        return FusedLAMBState(step=jnp.asarray(d["step"], jnp.int32),
+                        params=jnp.asarray(d["params"]),
+                        exp_avg=jnp.asarray(d["exp_avg"]),
+                        exp_avg_sq=jnp.asarray(d["exp_avg_sq"]))
 
 
 class FusedMixedPrecisionLamb(FusedLAMB):

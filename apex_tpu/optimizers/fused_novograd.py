@@ -44,8 +44,9 @@ class FusedNovoGrad:
         self.spec = None
 
     def init(self, params) -> FusedNovoGradState:
-        self.spec = F.make_spec(params)
-        flat = F.flatten(params, jnp.float32, pad_to=K.FLAT_TILE)
+        self.spec = F.make_spec(params, align=K._LANES)
+        flat = F.flatten(params, jnp.float32, pad_to=K.FLAT_TILE,
+                         align=K._LANES)
         n_tensors = len(self.spec.sizes)
         return FusedNovoGradState(
             step=jnp.zeros((), jnp.int32), params=flat,
@@ -54,16 +55,16 @@ class FusedNovoGrad:
 
     def step(self, state: FusedNovoGradState, grads, lr=None, inv_scale=1.0,
              found_inf=False):
-        g_flat = F.flatten(grads, jnp.float32, pad_to=K.FLAT_TILE) * jnp.asarray(
+        g_flat = F.flatten(grads, jnp.float32, pad_to=K.FLAT_TILE,
+                           align=K._LANES) * jnp.asarray(
             inv_scale, jnp.float32)
         found = jnp.asarray(found_inf)
         step_next = state.step + jnp.where(found, 0, 1).astype(jnp.int32)
         lr_val = self.lr if lr is None else lr
-        sizes = self.spec.sizes
 
         # per-tensor ||g||^2 EMA (fused_novograd.py: v init at first step
         # with the raw norm unless init_zero)
-        gn2 = jnp.square(K.per_tensor_l2norm(g_flat, sizes))
+        gn2 = jnp.square(K.per_tensor_l2norm_aligned(g_flat, self.spec))
         first = state.step == 0
         if self.init_zero:
             v_prev = state.exp_avg_sq
@@ -73,7 +74,8 @@ class FusedNovoGrad:
             v_new = jnp.where(first, gn2, v_cont)
 
         denom = jnp.sqrt(v_new) + self.eps
-        denom_elem = K.expand_per_tensor(denom, sizes, state.params.shape[0])
+        denom_elem = K.expand_per_tensor_aligned(denom, self.spec,
+                                                 state.params.shape[0])
 
         p32 = state.params
         gg = g_flat / denom_elem
@@ -96,3 +98,17 @@ class FusedNovoGrad:
         new_state = FusedNovoGradState(step=step_next, params=p, exp_avg=m,
                                        exp_avg_sq=v)
         return F.unflatten(p, self.spec), new_state
+
+    # --- checkpoint parity -------------------------------------------------
+    def state_dict(self, state: FusedNovoGradState) -> dict:
+        return {"step": state.step, "params": state.params,
+                "exp_avg": state.exp_avg, "exp_avg_sq": state.exp_avg_sq,
+                "flat_layout": F.layout_dict(self.spec)}
+
+    def load_state_dict(self, d: dict) -> FusedNovoGradState:
+        if self.spec is not None:
+            F.check_layout(self.spec, d, "FusedNovoGrad")
+        return FusedNovoGradState(step=jnp.asarray(d["step"], jnp.int32),
+                        params=jnp.asarray(d["params"]),
+                        exp_avg=jnp.asarray(d["exp_avg"]),
+                        exp_avg_sq=jnp.asarray(d["exp_avg_sq"]))

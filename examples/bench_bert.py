@@ -1,0 +1,87 @@
+"""BERT-Large pretraining-step benchmark — FusedLAMB + fused kernels.
+
+≡ the BASELINE config "BERT-Large pretraining with FusedLAMB +
+fused_dense": one full MLM+NSP training step (fwd + bwd + LAMB) on one
+chip, sequences/sec printed as JSON.
+
+Run:  python examples/bench_bert.py [--batch 8] [--seq 512] [--iters 10]
+"""
+
+from __future__ import annotations
+
+import os as _os
+import sys as _sys
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
+
+import argparse
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from apex_tpu.models.bert import Bert, BertConfig
+from apex_tpu.optimizers.fused_lamb import FusedLAMB
+from apex_tpu.parallel import mesh as M
+from apex_tpu.transformer.training import (
+    init_sharded_optimizer,
+    make_tp_dp_train_step,
+)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--seq", type=int, default=512)
+    ap.add_argument("--iters", type=int, default=10)
+    args = ap.parse_args()
+
+    on_tpu = jax.default_backend() not in ("cpu",)
+    if not on_tpu:
+        args.batch, args.seq, args.iters = 2, 64, 2
+
+    M.destroy_model_parallel()
+    mesh = M.initialize_model_parallel(devices=jax.devices()[:1])
+    cfg = BertConfig(seq_len=args.seq, dtype=jnp.bfloat16) if on_tpu else \
+        BertConfig(seq_len=args.seq, hidden=128, num_layers=2, num_heads=4,
+                   dtype=jnp.bfloat16)
+    model = Bert(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+    opt = FusedLAMB(lr=1e-4, weight_decay=0.01)
+    opt_state = init_sharded_optimizer(opt, model, params, mesh)
+    del params
+
+    key = jax.random.PRNGKey(1)
+    tokens = jax.random.randint(key, (args.batch, args.seq), 0,
+                                cfg.vocab_size)
+    mlm_labels = jnp.roll(tokens, -1, axis=1)
+    loss_mask = jax.random.bernoulli(jax.random.PRNGKey(2), 0.15,
+                                     (args.batch, args.seq))
+    nsp = jax.random.randint(jax.random.PRNGKey(3), (args.batch,), 0, 2)
+
+    def loss_fn(p, tokens, labels):
+        return model.loss(p, tokens, labels, loss_mask, nsp_labels=nsp)
+
+    step = make_tp_dp_train_step(model, opt, mesh, loss_fn=loss_fn,
+                                 donate=True)
+
+    for _ in range(2):
+        opt_state, loss = step(opt_state, tokens, mlm_labels)
+    np.asarray(loss)
+    t0 = time.perf_counter()
+    for _ in range(args.iters):
+        opt_state, loss = step(opt_state, tokens, mlm_labels)
+    np.asarray(loss)
+    dt = (time.perf_counter() - t0) / args.iters
+    print(json.dumps({
+        "metric": "bert_large_lamb_seqs_per_sec_per_chip",
+        "value": round(args.batch / dt, 1),
+        "unit": "sequences/s",
+        "s_per_iter": round(dt, 4),
+        "vs_baseline": 1.0,
+    }))
+
+
+if __name__ == "__main__":
+    main()

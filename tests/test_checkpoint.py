@@ -44,3 +44,31 @@ def test_checkpoint_roundtrip(tmp_path):
     p2, _ = opt.step(state2, {"w": jnp.ones((4, 3))})
     np.testing.assert_allclose(np.asarray(p1["w"]), np.asarray(p2["w"]),
                                rtol=1e-6)
+
+
+def test_flat_layout_guard():
+    """A checkpoint written under one flat layout must not restore into
+    another (the align=128 offsets differ from the unaligned ones even
+    when FLAT_TILE rounding makes the buffer lengths coincide)."""
+    import jax
+    import jax.numpy as jnp
+    import pytest
+    from apex_tpu.optimizers import FusedAdam, FusedLAMB
+
+    params = {"w": jnp.ones((300,)), "b": jnp.ones((7,))}
+    lamb = FusedLAMB(lr=1e-3)   # align=128 spec
+    st = lamb.init(params)
+    d = lamb.state_dict(st)
+    assert d["flat_layout"]["align"] == 128
+    # roundtrip ok
+    lamb.load_state_dict(d)
+    # missing layout record + aligned spec -> loud failure
+    d2 = {k: v for k, v in d.items() if k != "flat_layout"}
+    with pytest.raises(ValueError, match="flat_layout"):
+        lamb.load_state_dict(d2)
+    # mismatched layout -> loud failure
+    adam = FusedAdam(lr=1e-3)
+    adam.init(params)
+    bad = dict(d)
+    with pytest.raises(ValueError, match="does not match"):
+        adam.load_state_dict(bad)
