@@ -185,8 +185,11 @@ class GPT:
         v = v.transpose(1, 2, 0, 3)
         if c.use_flash_attention:
             from apex_tpu.ops.flash_attention import flash_attention
+            rate = c.dropout if key is not None else 0.0
             ctx = flash_attention(q, k, v, causal=True,
-                                  softmax_scale=1.0 / math.sqrt(c.head_dim))
+                                  softmax_scale=1.0 / math.sqrt(c.head_dim),
+                                  dropout_rate=rate,
+                                  dropout_key=key if rate > 0 else None)
         else:
             scores = jnp.einsum("bnsh,bnth->bnst", q, k,
                                 preferred_element_type=jnp.float32

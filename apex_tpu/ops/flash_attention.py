@@ -36,12 +36,38 @@ from apex_tpu.ops._common import pallas_interpret, use_pallas
 _NEG_INF = -1e30
 
 
+def _dropout_keep(seed_ref, i, j, t, shape, rate):
+    """Deterministic per-score-block keep mask.
+
+    ≡ the reference FMHA's philox dropout (apex/contrib/csrc/fmha/src/
+    fmha/softmax.h): counter-based bits seeded by (seed, block coords)
+    so the BACKWARD kernels regenerate the identical mask from the same
+    seed without storing sq x sk bytes.  Works in both grid orders
+    because (i, j, t) are the logical (batch*head, q-block, k-block)
+    ids, not the grid axes."""
+    # single-scalar seeding (multi-arg prng_seed doesn't lower on all
+    # libtpu versions): mix (seed, block coords) with a Knuth-style LCG
+    h = seed_ref[0, 0]
+    for c in (i, j, t):
+        h = h * jnp.int32(1000003) + jnp.int32(c)
+    pltpu.prng_seed(h)
+    bits = pltpu.prng_random_bits(shape)
+    # integer-only compare (Mosaic has no uint32->f32 cast): clear the
+    # sign bit for a uniform int32 in [0, 2^31) and threshold against
+    # rate * 2^31
+    r = bits.astype(jnp.int32) & jnp.int32(0x7FFFFFFF)
+    thresh = jnp.int32(int(rate * 2147483648.0))
+    return r >= thresh
+
+
 # --------------------------- reference (jnp) path ---------------------------
 
 def attention_reference(q, k, v, *, causal=False, softmax_scale=None,
-                        bias=None):
+                        bias=None, dropout_rate=0.0, dropout_key=None):
     """Plain softmax attention, fp32 accumulation (the parity oracle,
-    ≡ the python fallback paths in apex/contrib/multihead_attn)."""
+    ≡ the python fallback paths in apex/contrib/multihead_attn).
+    Dropout masks the post-softmax attention weights (bernoulli stream —
+    a different stream than the kernel's philox, same distribution)."""
     d = q.shape[-1]
     scale = softmax_scale if softmax_scale is not None else 1.0 / math.sqrt(d)
     s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
@@ -53,14 +79,19 @@ def attention_reference(q, k, v, *, causal=False, softmax_scale=None,
         mask = jnp.triu(jnp.ones((sq, sk), bool), k=1)
         s = jnp.where(mask, _NEG_INF, s)
     p = jax.nn.softmax(s, axis=-1)
+    if dropout_rate > 0.0:
+        keep = jax.random.bernoulli(dropout_key, 1.0 - dropout_rate, p.shape)
+        p = jnp.where(keep, p / (1.0 - dropout_rate), 0.0)
     return jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32)
                       ).astype(q.dtype)
 
 
 # ------------------------------ forward kernel ------------------------------
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
-                m_scr, l_scr, acc_scr, *, scale, causal, bq, bk, nk):
+def _fwd_kernel(q_ref, k_ref, v_ref, seed_ref, o_ref, lse_ref,
+                m_scr, l_scr, acc_scr, *, scale, causal, bq, bk, nk,
+                dropout_rate):
+    i = pl.program_id(0)
     j = pl.program_id(1)  # q block
     t = pl.program_id(2)  # k block
 
@@ -91,8 +122,16 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         p = jnp.exp(s - m_new)
         alpha = jnp.exp(m_prev - m_new)
         l_scr[...] = l_scr[...] * alpha + jnp.sum(p, axis=1, keepdims=True)
+        if dropout_rate > 0.0:
+            # dropout is linear in p, so masking before the (deferred)
+            # 1/l normalization equals dropout(softmax(s)) exactly; the
+            # denominator l stays the raw softmax sum
+            keep = _dropout_keep(seed_ref, i, j, t, (bq, bk), dropout_rate)
+            p_acc = jnp.where(keep, p, 0.0) * (1.0 / (1.0 - dropout_rate))
+        else:
+            p_acc = p
         acc_scr[...] = acc_scr[...] * alpha + jax.lax.dot(
-            p.astype(v_ref.dtype), v_ref[0],
+            p_acc.astype(v_ref.dtype), v_ref[0],
             preferred_element_type=jnp.float32)
         m_scr[...] = m_new
 
@@ -106,7 +145,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
 # ------------------------------ backward kernels ----------------------------
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                   dq_ref, dq_scr, *, scale, causal, bq, bk, nk):
+                   seed_ref, dq_ref, dq_scr, *, scale, causal, bq, bk, nk,
+                   dropout_rate):
+    i = pl.program_id(0)
     j = pl.program_id(1)
     t = pl.program_id(2)
 
@@ -131,6 +172,9 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dp = jax.lax.dot_general(do_ref[0], v_ref[0],
                                  (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
+        if dropout_rate > 0.0:
+            keep = _dropout_keep(seed_ref, i, j, t, (bq, bk), dropout_rate)
+            dp = jnp.where(keep, dp, 0.0) * (1.0 / (1.0 - dropout_rate))
         ds = p * (dp - delta_ref[0])
         dq_scr[...] += scale * jax.lax.dot(
             ds.astype(k_ref.dtype), k_ref[0],
@@ -142,8 +186,9 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    dk_ref, dv_ref, dk_scr, dv_scr, *, scale, causal,
-                    bq, bk, nq):
+                    seed_ref, dk_ref, dv_ref, dk_scr, dv_scr, *, scale,
+                    causal, bq, bk, nq, dropout_rate):
+    i = pl.program_id(0)
     t = pl.program_id(1)  # k block
     j = pl.program_id(2)  # q block (sequential inner)
 
@@ -166,12 +211,20 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             cols = t * bk + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
             s = jnp.where(cols > rows, _NEG_INF, s)
         p = jnp.exp(s - lse_ref[0])                     # (bq, bk)
+        if dropout_rate > 0.0:
+            keep = _dropout_keep(seed_ref, i, j, t, (bq, bk), dropout_rate)
+            inv = 1.0 / (1.0 - dropout_rate)
+            p_v = jnp.where(keep, p, 0.0) * inv
+        else:
+            p_v = p
         dv_scr[...] += jax.lax.dot_general(
-            p.astype(do_ref.dtype), do_ref[0], (((0,), (0,)), ((), ())),
+            p_v.astype(do_ref.dtype), do_ref[0], (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)         # (bk, d)
         dp = jax.lax.dot_general(do_ref[0], v_ref[0],
                                  (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
+        if dropout_rate > 0.0:
+            dp = jnp.where(keep, dp, 0.0) * inv
         ds = p * (dp - delta_ref[0])                    # (bq, bk)
         dk_scr[...] += scale * jax.lax.dot_general(
             ds.astype(q_ref.dtype), q_ref[0], (((0,), (0,)), ((), ())),
@@ -197,21 +250,24 @@ def _flatten_bh(x):
     return x.reshape(b * h, s, d)
 
 
-def _fwd_impl(q, k, v, scale, causal):
+def _fwd_impl(q, k, v, scale, causal, dropout_rate=0.0, seed=None):
     b, h, sq, d = q.shape
     sk = k.shape[2]
     bq, bk = _pick_block(sq), _pick_block(sk)
     qf, kf, vf = _flatten_bh(q), _flatten_bh(k), _flatten_bh(v)
     bh = b * h
     nq, nk = sq // bq, sk // bk
+    if seed is None:
+        seed = jnp.zeros((1, 1), jnp.int32)
     o, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, scale=scale, causal=causal, bq=bq,
-                          bk=bk, nk=nk),
+                          bk=bk, nk=nk, dropout_rate=dropout_rate),
         grid=(bh, nq, nk),
         in_specs=[
             pl.BlockSpec((1, bq, d), lambda i, j, t: (i, j, 0)),
             pl.BlockSpec((1, bk, d), lambda i, j, t: (i, t, 0)),
             pl.BlockSpec((1, bk, d), lambda i, j, t: (i, t, 0)),
+            pl.BlockSpec((1, 1), lambda i, j, t: (0, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, bq, d), lambda i, j, t: (i, j, 0)),
@@ -227,29 +283,33 @@ def _fwd_impl(q, k, v, scale, causal):
             pltpu.VMEM((bq, d), jnp.float32),
         ],
         interpret=pallas_interpret(),
-    )(qf, kf, vf)
+    )(qf, kf, vf, seed)
     return o.reshape(b, h, sq, d), lse.reshape(b, h, sq, 1)
 
 
-def _bwd_impl(q, k, v, o, lse, do, scale, causal):
+def _bwd_impl(q, k, v, o, lse, do, scale, causal, dropout_rate=0.0,
+              seed=None):
     b, h, sq, d = q.shape
     sk = k.shape[2]
     bq, bk = _pick_block(sq), _pick_block(sk)
     nq, nk = sq // bq, sk // bk
     bh = b * h
+    if seed is None:
+        seed = jnp.zeros((1, 1), jnp.int32)
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
                     axis=-1, keepdims=True)  # (b,h,sq,1)
     args = [_flatten_bh(q), _flatten_bh(k), _flatten_bh(v),
             _flatten_bh(do), lse.reshape(bh, sq, 1),
-            delta.reshape(bh, sq, 1)]
+            delta.reshape(bh, sq, 1), seed]
     qspec = pl.BlockSpec((1, bq, d), lambda i, j, t: (i, j, 0))
     kspec = pl.BlockSpec((1, bk, d), lambda i, j, t: (i, t, 0))
     r1 = pl.BlockSpec((1, bq, 1), lambda i, j, t: (i, j, 0))
+    sspec1 = pl.BlockSpec((1, 1), lambda i, j, t: (0, 0))
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
-                          bq=bq, bk=bk, nk=nk),
+                          bq=bq, bk=bk, nk=nk, dropout_rate=dropout_rate),
         grid=(bh, nq, nk),
-        in_specs=[qspec, kspec, kspec, qspec, r1, r1],
+        in_specs=[qspec, kspec, kspec, qspec, r1, r1, sspec1],
         out_specs=qspec,
         out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
@@ -259,11 +319,12 @@ def _bwd_impl(q, k, v, o, lse, do, scale, causal):
     qspec2 = pl.BlockSpec((1, bq, d), lambda i, t, j: (i, j, 0))
     kspec2 = pl.BlockSpec((1, bk, d), lambda i, t, j: (i, t, 0))
     r2 = pl.BlockSpec((1, bq, 1), lambda i, t, j: (i, j, 0))
+    sspec2 = pl.BlockSpec((1, 1), lambda i, t, j: (0, 0))
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
-                          bq=bq, bk=bk, nq=nq),
+                          bq=bq, bk=bk, nq=nq, dropout_rate=dropout_rate),
         grid=(bh, nk, nq),
-        in_specs=[qspec2, kspec2, kspec2, qspec2, r2, r2],
+        in_specs=[qspec2, kspec2, kspec2, qspec2, r2, r2, sspec2],
         out_specs=[kspec2, kspec2],
         out_shape=[jax.ShapeDtypeStruct((bh, sk, d), k.dtype),
                    jax.ShapeDtypeStruct((bh, sk, d), v.dtype)],
@@ -274,20 +335,24 @@ def _bwd_impl(q, k, v, o, lse, do, scale, causal):
     return (dq.reshape(q.shape), dk.reshape(k.shape), dv.reshape(v.shape))
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
-def _flash(q, k, v, scale, causal):
-    o, _ = _fwd_impl(q, k, v, scale, causal)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _flash(q, k, v, scale, causal, dropout_rate, seed):
+    o, _ = _fwd_impl(q, k, v, scale, causal, dropout_rate, seed)
     return o
 
 
-def _flash_fwd(q, k, v, scale, causal):
-    o, lse = _fwd_impl(q, k, v, scale, causal)
-    return o, (q, k, v, o, lse)
+def _flash_fwd(q, k, v, scale, causal, dropout_rate, seed):
+    o, lse = _fwd_impl(q, k, v, scale, causal, dropout_rate, seed)
+    return o, (q, k, v, o, lse, seed)
 
 
-def _flash_bwd(scale, causal, res, do):
-    q, k, v, o, lse = res
-    return _bwd_impl(q, k, v, o, lse, do, scale, causal)
+def _flash_bwd(scale, causal, dropout_rate, res, do):
+    q, k, v, o, lse, seed = res
+    dq, dk, dv = _bwd_impl(q, k, v, o, lse, do, scale, causal,
+                           dropout_rate, seed)
+    import numpy as _np
+    dseed = _np.zeros(seed.shape, dtype=jax.dtypes.float0)
+    return dq, dk, dv, dseed
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -297,16 +362,37 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 
 def flash_attention(q, k, v, *, causal: bool = False,
                     softmax_scale: Optional[float] = None,
+                    dropout_rate: float = 0.0,
+                    dropout_key=None,
                     use_pallas_override: Optional[bool] = None):
     """Flash attention over (batch, heads, seq, head_dim).
 
     ≡ apex.contrib.fmha.FMHAFun (apex/contrib/fmha/fmha.py:33-72) with
     the seq≤512/head-64 restriction removed, and the core of the
     fast_multihead_attn variants (self/encdec attention cores).
+    Attention dropout runs IN-kernel with a counter-based mask
+    regenerated in backward (≡ the reference's philox dropout,
+    fmha/src/fmha/softmax.h) — no sq x sk mask ever reaches HBM, so
+    dropout works at any sequence length.
     """
     d = q.shape[-1]
     scale = softmax_scale if softmax_scale is not None else 1.0 / math.sqrt(d)
-    if (use_pallas(use_pallas_override)
-            and _pick_block(q.shape[2]) and _pick_block(k.shape[2])):
-        return _flash(q, k, v, scale, causal)
-    return attention_reference(q, k, v, causal=causal, softmax_scale=scale)
+    if not 0.0 <= dropout_rate < 1.0:
+        raise ValueError(f"dropout_rate must be in [0, 1), got {dropout_rate}")
+    if dropout_rate > 0.0 and dropout_key is None:
+        raise ValueError("dropout_rate > 0 requires dropout_key")
+    # the in-kernel dropout path needs the TPU hardware PRNG
+    # (pltpu.prng_seed has no interpret-mode lowering)
+    kernel_ok = (use_pallas(use_pallas_override)
+                 and _pick_block(q.shape[2]) and _pick_block(k.shape[2])
+                 and (dropout_rate == 0.0 or not pallas_interpret()))
+    if kernel_ok:
+        if dropout_rate > 0.0:
+            seed = jax.random.randint(dropout_key, (1, 1), -2**31, 2**31 - 1,
+                                      dtype=jnp.int32)
+        else:
+            seed = jnp.zeros((1, 1), jnp.int32)
+        return _flash(q, k, v, scale, causal, float(dropout_rate), seed)
+    return attention_reference(q, k, v, causal=causal, softmax_scale=scale,
+                               dropout_rate=dropout_rate,
+                               dropout_key=dropout_key)

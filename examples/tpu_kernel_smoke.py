@@ -98,6 +98,40 @@ def main():
           grad_of=lambda q: flash_attention(q, q, q, causal=True)
           .astype(jnp.float32).sum())
 
+    from apex_tpu.ops.flash_attention import _flash
+
+    def dropout_checks():
+        B, H, S, D = 2, 4, 512, 64
+        qq = jax.random.normal(jax.random.PRNGKey(0), (B, H, S, D))
+        kk = jax.random.normal(jax.random.PRNGKey(1), (B, H, S, D))
+        vv = jax.random.normal(jax.random.PRNGKey(2), (B, H, S, D))
+        cc = jax.random.normal(jax.random.PRNGKey(3), (B, H, S, D))
+        seed = jnp.asarray([[777]], jnp.int32)
+        o1 = np.asarray(_flash(qq, kk, vv, 0.125, True, 0.2, seed))
+        o2 = np.asarray(_flash(qq, kk, vv, 0.125, True, 0.2, seed))
+        assert np.array_equal(o1, o2), "dropout mask not seed-deterministic"
+        # v is linear under a fixed mask: directional FD must be exact,
+        # which proves the backward kernels regenerate the forward mask
+        f = lambda v_: jnp.vdot(_flash(qq, kk, v_, 0.125, True, 0.2, seed),
+                                cc)
+        gv = jax.grad(f)(vv)
+        dirv = jax.random.normal(jax.random.PRNGKey(4), vv.shape)
+        fd = float(f(vv + 0.5 * dirv)) - float(f(vv - 0.5 * dirv))
+        an = float(jnp.vdot(gv, dirv))
+        assert abs(fd - an) < 1e-2 * abs(an) + 1e-3, (fd, an)
+        # q-grad along the gradient direction (strong signal vs fp32
+        # noise): proves the dq kernel's dp mask matches forward
+        fq = lambda q_: jnp.vdot(_flash(q_, kk, vv, 0.125, True, 0.2,
+                                        seed), cc)
+        g = jax.grad(fq)(qq)
+        gn = float(jnp.sqrt(jnp.vdot(g, g)))
+        d2 = g / gn
+        fd = (float(fq(qq + 0.05 * d2)) - float(fq(qq - 0.05 * d2))) / 0.1
+        assert abs(fd - gn) < 3e-2 * gn, (fd, gn)
+        return True
+
+    check("flash_dropout_mask_consistency", lambda: dropout_checks())
+
     from apex_tpu.ops.welford import batch_stats
     xc = jax.random.normal(jax.random.PRNGKey(9), (32, 56, 56, 64),
                            jnp.bfloat16)

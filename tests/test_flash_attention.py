@@ -74,3 +74,36 @@ def test_flash_long_seq_blocks():
     want = attention_reference(q, k, v, causal=True)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=1e-4, atol=1e-4)
+
+
+def test_flash_dropout_fallback_api():
+    """dropout on the non-kernel path: masks attention weights, scales
+    by 1/keep, deterministic per key, E[out] tracks the no-dropout
+    output (the in-kernel philox path is validated on hardware by
+    examples/tpu_kernel_smoke.py)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import pytest
+    from apex_tpu.ops.flash_attention import flash_attention
+
+    q = jax.random.normal(jax.random.PRNGKey(0), (1, 2, 64, 32))
+    k = jax.random.normal(jax.random.PRNGKey(1), (1, 2, 64, 32))
+    v = jax.random.normal(jax.random.PRNGKey(2), (1, 2, 64, 32))
+    with pytest.raises(ValueError, match="dropout_key"):
+        flash_attention(q, k, v, dropout_rate=0.1)
+    key = jax.random.PRNGKey(3)
+    o1 = flash_attention(q, k, v, causal=True, dropout_rate=0.3,
+                         dropout_key=key)
+    o2 = flash_attention(q, k, v, causal=True, dropout_rate=0.3,
+                         dropout_key=key)
+    np.testing.assert_array_equal(np.asarray(o1), np.asarray(o2))
+    base = np.asarray(flash_attention(q, k, v, causal=True))
+    acc = np.zeros_like(base)
+    n = 32
+    for i in range(n):
+        acc += np.asarray(flash_attention(
+            q, k, v, causal=True, dropout_rate=0.3,
+            dropout_key=jax.random.PRNGKey(50 + i)))
+    rel = np.abs(acc / n - base).mean() / np.abs(base).mean()
+    assert rel < 0.3, rel
