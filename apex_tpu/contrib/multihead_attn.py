@@ -88,21 +88,25 @@ class SelfMultiheadAttn:
 
     def _core(self, q, k, v, mask, is_training, dropout_key,
               use_pallas_override):
-        if mask is None and self.dropout == 0.0:
+        rate = self.dropout if (is_training and dropout_key is not None) \
+            else 0.0
+        if mask is None:
+            # dropout runs IN-kernel (counter-based mask, ≡ FMHA philox
+            # dropout) so the no-mask path never materializes sq x sk
             return flash_attention(q, k, v, causal=False,
                                    softmax_scale=self.scaling,
+                                   dropout_rate=rate,
+                                   dropout_key=dropout_key if rate > 0
+                                   else None,
                                    use_pallas_override=use_pallas_override)
-        # masked / dropout path: reference math (≡ MaskSoftmaxDropout,
-        # mask_softmax_dropout_func.py)
+        # masked path: reference math (≡ MaskSoftmaxDropout,
+        # mask_softmax_dropout_func.py); mask is non-None here
+        from apex_tpu.ops._common import dropout as _dropout_fn
         s = jnp.einsum("bnqd,bnkd->bnqk", q.astype(jnp.float32),
                        k.astype(jnp.float32)) * self.scaling
-        if mask is not None:
-            s = jnp.where(mask, -10000.0, s)
+        s = jnp.where(mask, -10000.0, s)
         p = jax.nn.softmax(s, axis=-1)
-        if is_training and self.dropout > 0 and dropout_key is not None:
-            keep = 1.0 - self.dropout
-            dm = jax.random.bernoulli(dropout_key, keep, p.shape)
-            p = jnp.where(dm, p / keep, 0.0)
+        p = _dropout_fn(dropout_key, rate, p)
         return jnp.einsum("bnqk,bnkd->bnqd", p,
                           v.astype(jnp.float32)).astype(q.dtype)
 

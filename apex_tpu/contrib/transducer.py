@@ -42,9 +42,8 @@ class TransducerJoint:
         if self.relu:
             h = jnp.maximum(h, 0)
         if self.dropout and is_training and dropout_key is not None:
-            keep = 1.0 - self.dropout
-            mask = jax.random.bernoulli(dropout_key, keep, h.shape)
-            h = jnp.where(mask, h / keep, 0.0)
+            from apex_tpu.ops._common import dropout
+            h = dropout(dropout_key, self.dropout, h)
         return h
 
 

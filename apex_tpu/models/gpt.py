@@ -165,11 +165,8 @@ class GPT:
         return fused_layer_norm(x, w, b)
 
     def _dropout(self, key, x):
-        if self.c.dropout == 0.0 or key is None:
-            return x
-        keep = 1.0 - self.c.dropout
-        mask = jax.random.bernoulli(key, keep, x.shape)
-        return jnp.where(mask, x / keep, 0.0)
+        from apex_tpu.ops._common import dropout
+        return dropout(key, self.c.dropout, x)
 
     def _attention(self, block_params, qkv_mod, proj_mod, x, key):
         """x: (S[, /tp], B, H) local.  Heads sharded over tp."""

@@ -49,3 +49,17 @@ def row_block(rows: int, hidden: int, bytes_per_elt: int = 4,
     b = max(align, vmem_budget // max(1, hidden * bytes_per_elt))
     b = min(b, cap, round_up(rows, align))
     return round_up(b, align) if b % align else b
+
+
+def dropout(key, rate: float, x):
+    """Inverted-bernoulli dropout: zero with probability `rate`, scale
+    survivors by 1/(1-rate).  The ONE implementation shared by the dense
+    attention oracle, the models, and contrib modules so their dropout
+    semantics can never diverge (the flash kernel's in-kernel
+    counter-based mask is its hardware-PRNG counterpart)."""
+    if rate == 0.0 or key is None:
+        return x
+    keep = 1.0 - rate
+    mask = jax.random.bernoulli(key, keep, x.shape)
+    import jax.numpy as jnp
+    return jnp.where(mask, x / keep, jnp.zeros((), x.dtype))

@@ -31,6 +31,7 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from apex_tpu.ops._common import dropout as _dense_dropout
 from apex_tpu.ops._common import pallas_interpret, use_pallas
 
 _NEG_INF = -1e30
@@ -79,9 +80,7 @@ def attention_reference(q, k, v, *, causal=False, softmax_scale=None,
         mask = jnp.triu(jnp.ones((sq, sk), bool), k=1)
         s = jnp.where(mask, _NEG_INF, s)
     p = jax.nn.softmax(s, axis=-1)
-    if dropout_rate > 0.0:
-        keep = jax.random.bernoulli(dropout_key, 1.0 - dropout_rate, p.shape)
-        p = jnp.where(keep, p / (1.0 - dropout_rate), 0.0)
+    p = _dense_dropout(dropout_key, dropout_rate, p)
     return jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32)
                       ).astype(q.dtype)
 
@@ -383,6 +382,12 @@ def flash_attention(q, k, v, *, causal: bool = False,
         raise ValueError("dropout_rate > 0 requires dropout_key")
     # the in-kernel dropout path needs the TPU hardware PRNG
     # (pltpu.prng_seed has no interpret-mode lowering)
+    if (dropout_rate > 0.0 and use_pallas_override is True
+            and pallas_interpret()):
+        raise NotImplementedError(
+            "in-kernel dropout needs the TPU hardware PRNG; interpret "
+            "mode has no lowering for it (and its mask stream differs "
+            "from the dense fallback's, so goldens would not transfer)")
     kernel_ok = (use_pallas(use_pallas_override)
                  and _pick_block(q.shape[2]) and _pick_block(k.shape[2])
                  and (dropout_rate == 0.0 or not pallas_interpret()))
