@@ -40,9 +40,40 @@ class DistributedFusedAdamState(NamedTuple):
     exp_avg_sq: jnp.ndarray
 
 
-class DistributedFusedAdam:
+class _ShardedFlat:
+    """Shared flat-buffer plumbing for the ZeRO optimizers: ONE place
+    defines the (dtype, align, pad_to) layout so init and step can never
+    drift apart, plus the checkpoint layout guard (see flat.check_layout
+    — total lengths can coincide after FLAT_TILE rounding, so a shape
+    check alone cannot catch offset-moving layout changes)."""
+
+    _ALIGN = 1  # subclasses override when they need lane-aligned leaves
+
+    def _make_spec(self, params):
+        self.spec = F.make_spec(params, align=self._ALIGN)
+
+    def _flatten(self, tree):
+        return F.flatten(tree, jnp.float32, align=self._ALIGN,
+                         pad_to=self.num_shards * K.FLAT_TILE)
+
+    def state_dict(self, state) -> dict:
+        d = dict(state._asdict())
+        d["flat_layout"] = F.layout_dict(self.spec)
+        return d
+
+    def load_state_dict(self, d: dict):
+        if self.spec is not None:
+            F.check_layout(self.spec, d, type(self).__name__)
+        cls = type(self)._STATE
+        return cls(**{k: jnp.asarray(v) for k, v in d.items()
+                      if k != "flat_layout"})
+
+
+class DistributedFusedAdam(_ShardedFlat):
     """ZeRO-2 Adam.  Shard-local: init/step run inside shard_map with the
     dp axis unmapped.  `num_shards` = dp world size (static)."""
+
+    _STATE = DistributedFusedAdamState
 
     def __init__(self, num_shards: int, lr=1e-3, bias_correction=True,
                  betas=(0.9, 0.999), eps=1e-8, adam_w_mode=True,
@@ -61,8 +92,8 @@ class DistributedFusedAdam:
         self.padded_total = None
 
     def init(self, params) -> DistributedFusedAdamState:
-        self.spec = F.make_spec(params)
-        flat = F.flatten(params, jnp.float32, pad_to=self.num_shards)
+        self._make_spec(params)
+        flat = self._flatten(params)
         self.padded_total = flat.shape[0]
         shard_size = self.padded_total // self.num_shards
         rank = lax.axis_index(self.axis_name)
@@ -78,7 +109,7 @@ class DistributedFusedAdam:
         Returns (full params pytree, new state).  The reduce-scatter
         averages over dp (≡ the reference's grad sync divide)."""
         ax = self.axis_name
-        g_flat = F.flatten(grads, jnp.float32, pad_to=self.num_shards)
+        g_flat = self._flatten(grads)
         # ZeRO-2 core: one reduce-scatter replaces DDP's allreduce
         g_shard = lax.psum_scatter(g_flat, ax, scatter_dimension=0,
                                    tiled=True) / self.num_shards
@@ -107,11 +138,14 @@ class DistributedFusedLAMBState(NamedTuple):
     exp_avg_sq: jnp.ndarray
 
 
-class DistributedFusedLAMB:
+class DistributedFusedLAMB(_ShardedFlat):
     """ZeRO-sharded LAMB ≡ DistributedFusedLAMB
     (distributed_fused_lamb.py:24): reduce-scattered grads, sharded
     moments, psum'd global grad norm, per-tensor trust ratios computed
     on gathered norms, sharded phase-2 update, all-gather params."""
+
+    _STATE = DistributedFusedLAMBState
+    _ALIGN = K._LANES  # lane-aligned leaves -> one-pass per-tensor norms
 
     def __init__(self, num_shards: int, lr=1e-3, bias_correction=True,
                  betas=(0.9, 0.999), eps=1e-6, weight_decay=0.01,
@@ -130,8 +164,8 @@ class DistributedFusedLAMB:
         self.padded_total = None
 
     def init(self, params):
-        self.spec = F.make_spec(params)
-        flat = F.flatten(params, jnp.float32, pad_to=self.num_shards)
+        self._make_spec(params)
+        flat = self._flatten(params)
         self.padded_total = flat.shape[0]
         shard_size = self.padded_total // self.num_shards
         rank = lax.axis_index(self.axis_name)
@@ -143,7 +177,7 @@ class DistributedFusedLAMB:
 
     def step(self, state, grads, lr=None, inv_scale=1.0, found_inf=False):
         ax = self.axis_name
-        g_flat = F.flatten(grads, jnp.float32, pad_to=self.num_shards) * jnp.asarray(
+        g_flat = self._flatten(grads) * jnp.asarray(
             inv_scale, jnp.float32)
         g_shard = lax.psum_scatter(g_flat, ax, scatter_dimension=0,
                                    tiled=True) / self.num_shards
@@ -170,12 +204,12 @@ class DistributedFusedLAMB:
         # computing segment sums of squares on the gathered buffers
         full_p = lax.all_gather(state.params_shard, ax, axis=0, tiled=True)
         full_u = lax.all_gather(u, ax, axis=0, tiled=True)
-        sizes = self.spec.sizes
-        wn = K.per_tensor_l2norm(full_p[: self.spec.total], sizes)
-        un = K.per_tensor_l2norm(full_u[: self.spec.total], sizes)
+        wn = K.per_tensor_l2norm_aligned(full_p, self.spec)
+        un = K.per_tensor_l2norm_aligned(full_u, self.spec)
         ratio = jnp.where((wn > 0) & (un > 0), wn / jnp.maximum(un, 1e-12),
                           1.0)
-        ratio_elem = K.expand_per_tensor(ratio, sizes, self.padded_total)
+        ratio_elem = K.expand_per_tensor_aligned(ratio, self.spec,
+                                                 self.padded_total)
         shard_size = self.padded_total // self.num_shards
         rank = lax.axis_index(ax)
         ratio_shard = lax.dynamic_slice(ratio_elem, (rank * shard_size,),

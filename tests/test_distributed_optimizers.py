@@ -5,6 +5,7 @@ DistributedFusedAdam over dp=8 must match single-rank FusedAdam exactly
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 from jax import shard_map
 from jax.sharding import PartitionSpec as P
 
@@ -69,10 +70,14 @@ def test_dist_adam_matches_fused_adam():
             np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6),
         new_params, ref_params)
 
-    # state really is sharded: each rank holds total/8 (padded) elements
+    # state really is sharded: each rank holds padded_total/8 elements,
+    # where padding rounds to num_shards x FLAT_TILE so every shard is a
+    # whole Pallas tile (in-place kernel, no per-step pad copies)
+    from apex_tpu.ops.optimizer_kernels import FLAT_TILE
     total = 13 * 7 + 7
-    padded = total + (-total) % DP
-    assert state.exp_avg.shape == (padded,)  # global view = 8 × shard
+    unit = DP * FLAT_TILE
+    padded = total + (-total) % unit
+    assert state.exp_avg.shape == (padded,)  # global view = 8 x shard
 
 
 def test_dist_lamb_smoke_and_parity():
@@ -105,3 +110,24 @@ def test_dist_lamb_smoke_and_parity():
         lambda a, b: np.testing.assert_allclose(
             np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5),
         new_params, ref_params)
+
+
+def test_zero_optimizer_layout_guard():
+    """ZeRO state_dicts carry the flat-layout fingerprint; restoring a
+    pre-layout (or mismatched) checkpoint fails loudly instead of
+    scrambling the lane-aligned offsets."""
+    mesh = M.initialize_model_parallel()
+    params = {"w": jnp.ones((300,)), "b": jnp.ones((7,))}
+    opt = DistributedFusedLAMB(num_shards=DP, lr=1e-3)
+    sspec = DistributedFusedLAMBState(P(), P("dp"), P("dp"), P("dp"))
+    state = jax.jit(shard_map(
+        lambda p: opt.init(p), mesh=mesh, in_specs=(P(),),
+        out_specs=sspec, check_vma=False))(params)
+    d = opt.state_dict(state)
+    assert d["flat_layout"]["align"] == 128
+    restored = opt.load_state_dict(d)
+    assert restored.params_shard.shape == state.params_shard.shape
+    bad = {k: v for k, v in d.items() if k != "flat_layout"}
+    with pytest.raises(ValueError, match="flat_layout"):
+        opt.load_state_dict(bad)
+    M.destroy_model_parallel()
