@@ -37,6 +37,28 @@ from apex_tpu.ops._common import pallas_interpret, use_pallas
 _NEG_INF = -1e30
 
 
+def _causal_dispatch(step_fn, j, t, bq, bk, causal):
+    """Run step_fn(masked) gated on the causal block structure: skip
+    blocks above the diagonal entirely; apply mask arithmetic only on
+    diagonal-crossing blocks (interior blocks take the unmasked path —
+    the per-score iota/compare/select chain is a large share of VPU
+    time)."""
+    if not causal:
+        step_fn(False)
+        return
+    on_diag = (t * bk + bk - 1) > (j * bq)
+    run = (t * bk) <= (j * bq + bq - 1)
+    pl.when(run & on_diag)(lambda: step_fn(True))
+    pl.when(run & jnp.logical_not(on_diag))(lambda: step_fn(False))
+
+
+def _causal_mask(st, j, t, bq, bk):
+    """Mask scores above the diagonal on a TRANSPOSED (bk, bq) block."""
+    krow = t * bk + lax.broadcasted_iota(jnp.int32, (bk, bq), 0)
+    qcol = j * bq + lax.broadcasted_iota(jnp.int32, (bk, bq), 1)
+    return jnp.where(krow > qcol, _NEG_INF, st)
+
+
 def _dropout_keep(seed_ref, i, j, t, shape, rate):
     """Deterministic per-score-block keep mask.
 
@@ -90,6 +112,11 @@ def attention_reference(q, k, v, *, causal=False, softmax_scale=None,
 def _fwd_kernel(q_ref, k_ref, v_ref, seed_ref, o_ref, lse_ref,
                 m_scr, l_scr, acc_scr, *, scale, causal, bq, bk, nk,
                 dropout_rate):
+    """Scores run TRANSPOSED (bk, bq): the softmax statistics (m, l,
+    lse) are then (1, bq) lane-major rows — fully-packed vregs instead
+    of 1/128-occupied columns, and the lse/delta HBM arrays are
+    (bh, nq, bq) with no minor-dim-1 tile padding (a (bh, sq, 1) fp32
+    array tiles to 128x its logical size on TPU)."""
     i = pl.program_id(0)
     j = pl.program_id(1)  # q block
     t = pl.program_id(2)  # k block
@@ -100,45 +127,42 @@ def _fwd_kernel(q_ref, k_ref, v_ref, seed_ref, o_ref, lse_ref,
         l_scr[...] = jnp.zeros_like(l_scr)
         acc_scr[...] = jnp.zeros_like(acc_scr)
 
-    run = True
-    if causal:
-        # skip blocks strictly above the diagonal
-        run = (t * bk) <= (j * bq + bq - 1)
-
-    @pl.when(run)
-    def _step():
+    def _step(masked):
         # native-dtype operands: MXU wants bf16 x bf16 -> fp32; a
         # pre-upcast to fp32 would push the matmul off the MXU
-        s = jax.lax.dot_general(q_ref[0], k_ref[0],
-                                (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * scale
-        if causal:
-            rows = j * bq + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-            cols = t * bk + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-            s = jnp.where(cols > rows, _NEG_INF, s)
-        m_prev = m_scr[...]
-        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
-        p = jnp.exp(s - m_new)
+        st = jax.lax.dot_general(k_ref[0], q_ref[0],
+                                 (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32) * scale
+        if masked:
+            st = _causal_mask(st, j, t, bq, bk)
+        m_prev = m_scr[...]                                     # (1, bq)
+        m_new = jnp.maximum(m_prev, jnp.max(st, axis=0, keepdims=True))
+        p = jnp.exp(st - m_new)                                 # (bk, bq)
         alpha = jnp.exp(m_prev - m_new)
-        l_scr[...] = l_scr[...] * alpha + jnp.sum(p, axis=1, keepdims=True)
+        l_scr[...] = l_scr[...] * alpha + jnp.sum(p, axis=0, keepdims=True)
         if dropout_rate > 0.0:
             # dropout is linear in p, so masking before the (deferred)
             # 1/l normalization equals dropout(softmax(s)) exactly; the
             # denominator l stays the raw softmax sum
-            keep = _dropout_keep(seed_ref, i, j, t, (bq, bk), dropout_rate)
+            keep = _dropout_keep(seed_ref, i, j, t, (bk, bq), dropout_rate)
             p_acc = jnp.where(keep, p, 0.0) * (1.0 / (1.0 - dropout_rate))
         else:
             p_acc = p
-        acc_scr[...] = acc_scr[...] * alpha + jax.lax.dot(
-            p_acc.astype(v_ref.dtype), v_ref[0],
+        # acc is kept transposed (d, bq) so alpha/l rows broadcast along
+        # lanes; (bk, d)^T-contract (bk, bq) -> (d, bq)
+        acc_scr[...] = acc_scr[...] * alpha + jax.lax.dot_general(
+            v_ref[0], p_acc.astype(v_ref.dtype), (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         m_scr[...] = m_new
 
+    _causal_dispatch(_step, j, t, bq, bk, causal)
+
     @pl.when(t == nk - 1)
     def _epilogue():
-        l = l_scr[...]
-        o_ref[0] = (acc_scr[...] / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
-        lse_ref[0] = m_scr[...] + jnp.log(jnp.maximum(l, 1e-30))
+        l = jnp.maximum(l_scr[...], 1e-30)                      # (1, bq)
+        o_ref[0] = (acc_scr[...] / l).T.astype(o_ref.dtype)
+        # lse rides as (1, nq, bq) per-head block; write q-block row j
+        lse_ref[0, j] = (m_scr[...] + jnp.log(l)).reshape(bq)
 
 
 # ------------------------------ backward kernels ----------------------------
@@ -154,30 +178,27 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     def _init():
         dq_scr[...] = jnp.zeros_like(dq_scr)
 
-    run = True
-    if causal:
-        run = (t * bk) <= (j * bq + bq - 1)
-
-    @pl.when(run)
-    def _step():
-        s = jax.lax.dot_general(q_ref[0], k_ref[0],
-                                (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * scale
-        if causal:
-            rows = j * bq + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-            cols = t * bk + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-            s = jnp.where(cols > rows, _NEG_INF, s)
-        p = jnp.exp(s - lse_ref[0])
-        dp = jax.lax.dot_general(do_ref[0], v_ref[0],
+    def _step(masked):
+        # transposed scores (bk, bq): lse/delta are (1, bq) lane rows
+        st = jax.lax.dot_general(k_ref[0], q_ref[0],
+                                 (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32) * scale
+        if masked:
+            st = _causal_mask(st, j, t, bq, bk)
+        p = jnp.exp(st - lse_ref[0, j])                         # (bk, bq)
+        dp = jax.lax.dot_general(v_ref[0], do_ref[0],
                                  (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         if dropout_rate > 0.0:
-            keep = _dropout_keep(seed_ref, i, j, t, (bq, bk), dropout_rate)
+            keep = _dropout_keep(seed_ref, i, j, t, (bk, bq), dropout_rate)
             dp = jnp.where(keep, dp, 0.0) * (1.0 / (1.0 - dropout_rate))
-        ds = p * (dp - delta_ref[0])
-        dq_scr[...] += scale * jax.lax.dot(
-            ds.astype(k_ref.dtype), k_ref[0],
+        ds = p * (dp - delta_ref[0, j])                         # (bk, bq)
+        # (bk, bq)^T-contract (bk, d) -> (bq, d)
+        dq_scr[...] += scale * jax.lax.dot_general(
+            ds.astype(k_ref.dtype), k_ref[0], (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
+
+    _causal_dispatch(_step, j, t, bq, bk, causal)
 
     @pl.when(t == nk - 1)
     def _epilogue():
@@ -196,38 +217,34 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk_scr[...] = jnp.zeros_like(dk_scr)
         dv_scr[...] = jnp.zeros_like(dv_scr)
 
-    run = True
-    if causal:
-        run = (t * bk) <= (j * bq + bq - 1)
-
-    @pl.when(run)
-    def _step():
-        s = jax.lax.dot_general(q_ref[0], k_ref[0],
-                                (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * scale
-        if causal:
-            rows = j * bq + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-            cols = t * bk + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-            s = jnp.where(cols > rows, _NEG_INF, s)
-        p = jnp.exp(s - lse_ref[0])                     # (bq, bk)
+    def _step(masked):
+        # transposed scores (bk, bq): lse/delta are (1, bq) lane rows
+        st = jax.lax.dot_general(k_ref[0], q_ref[0],
+                                 (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32) * scale
+        if masked:
+            st = _causal_mask(st, j, t, bq, bk)
+        p = jnp.exp(st - lse_ref[0, j])                 # (bk, bq)
         if dropout_rate > 0.0:
-            keep = _dropout_keep(seed_ref, i, j, t, (bq, bk), dropout_rate)
+            keep = _dropout_keep(seed_ref, i, j, t, (bk, bq), dropout_rate)
             inv = 1.0 / (1.0 - dropout_rate)
             p_v = jnp.where(keep, p, 0.0) * inv
         else:
             p_v = p
         dv_scr[...] += jax.lax.dot_general(
-            p_v.astype(do_ref.dtype), do_ref[0], (((0,), (0,)), ((), ())),
+            p_v.astype(do_ref.dtype), do_ref[0], (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)         # (bk, d)
-        dp = jax.lax.dot_general(do_ref[0], v_ref[0],
+        dp = jax.lax.dot_general(v_ref[0], do_ref[0],
                                  (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         if dropout_rate > 0.0:
             dp = jnp.where(keep, dp, 0.0) * inv
-        ds = p * (dp - delta_ref[0])                    # (bq, bk)
+        ds = p * (dp - delta_ref[0, j])                 # (bk, bq)
         dk_scr[...] += scale * jax.lax.dot_general(
-            ds.astype(q_ref.dtype), q_ref[0], (((0,), (0,)), ((), ())),
+            ds.astype(q_ref.dtype), q_ref[0], (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)         # (bk, d)
+
+    _causal_dispatch(_step, j, t, bq, bk, causal)
 
     @pl.when(j == nq - 1)
     def _epilogue():
@@ -235,13 +252,103 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
 
 
+def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                      seed_ref, dq_ref, dk_ref, dv_ref,
+                      dq_scr, dk_scr, dv_scr, *, scale, causal, bq, bk,
+                      nq, nk, dropout_rate):
+    """Single-pass backward: dq, dk, dv from ONE score/exp recompute.
+
+    The two-kernel split recomputes st/p twice (7 matmuls + 2 exp
+    chains); this fused grid (bh, q-block, k-block) does 5 matmuls + 1
+    exp chain.  dq accumulates per q block over the inner k loop (the
+    usual pattern); dk/dv accumulate across the OUTER q loop in a
+    full-(sk, d) VMEM scratch, which caps this path at moderate sk —
+    _bwd_impl falls back to the two-kernel path beyond that."""
+    i = pl.program_id(0)
+    j = pl.program_id(1)  # q block (outer)
+    t = pl.program_id(2)  # k block (inner)
+
+    @pl.when(t == 0)
+    def _init_dq():
+        dq_scr[...] = jnp.zeros_like(dq_scr)
+
+    @pl.when((j == 0) & (t == 0))
+    def _init_dkv():
+        dk_scr[...] = jnp.zeros_like(dk_scr)
+        dv_scr[...] = jnp.zeros_like(dv_scr)
+
+    def _step(masked):
+        rows = (pl.ds(t * bk, bk), slice(None))
+        st = jax.lax.dot_general(k_ref[0], q_ref[0],
+                                 (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32) * scale
+        if masked:
+            st = _causal_mask(st, j, t, bq, bk)
+        p = jnp.exp(st - lse_ref[0, j])                 # (bk, bq)
+        dp = jax.lax.dot_general(v_ref[0], do_ref[0],
+                                 (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        if dropout_rate > 0.0:
+            keep = _dropout_keep(seed_ref, i, j, t, (bk, bq), dropout_rate)
+            inv = 1.0 / (1.0 - dropout_rate)
+            p_v = jnp.where(keep, p, 0.0) * inv
+            dp = jnp.where(keep, dp, 0.0) * inv
+        else:
+            p_v = p
+        dv_scr[rows] += jax.lax.dot_general(
+            p_v.astype(do_ref.dtype), do_ref[0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)         # (bk, d)
+        ds = p * (dp - delta_ref[0, j])                 # (bk, bq)
+        dk_scr[rows] += scale * jax.lax.dot_general(
+            ds.astype(q_ref.dtype), q_ref[0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)         # (bk, d)
+        dq_scr[...] += scale * jax.lax.dot_general(
+            ds.astype(k_ref.dtype), k_ref[0], (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)         # (bq, d)
+
+    _causal_dispatch(_step, j, t, bq, bk, causal)
+
+    @pl.when(t == nk - 1)
+    def _write_dq():
+        dq_ref[0] = dq_scr[...].astype(dq_ref.dtype)
+
+    # dk/dv blocks are flushed to HBM every t step (their block index
+    # advances with t); only the final q pass (j == nq-1) leaves the
+    # complete sums behind — earlier writes are overwritten
+    dk_ref[0] = dk_scr[pl.ds(t * bk, bk), :].astype(dk_ref.dtype)
+    dv_ref[0] = dv_scr[pl.ds(t * bk, bk), :].astype(dv_ref.dtype)
+
+
 # ----------------------------- host-side plumbing ---------------------------
 
-def _pick_block(seq):
-    for b in (512, 256, 128, 64, 32, 16, 8):
-        if seq % b == 0:
+def _pick_block(seq, cap=512):
+    for b in (1024, 512, 256, 128, 64, 32, 16, 8):
+        if b <= cap and seq % b == 0:
             return b
     return None
+
+
+def _resolve_blocks(sq, sk, block_q, block_k):
+    """Default blocks, swept on v5e (docs/PERF.md): single block per
+    axis when the sequence fits (<=1024 — grid overhead dominates the
+    extra causal-mask work), else (512, 1024) to cap the fp32 score
+    tile at 2 MB of VMEM while keeping k-side matmuls wide.  Explicit
+    blocks must divide the sequence."""
+    if block_q is not None and sq % block_q:
+        raise ValueError(f"block_q={block_q} does not divide sq={sq}")
+    if block_k is not None and sk % block_k:
+        raise ValueError(f"block_k={block_k} does not divide sk={sk}")
+    bq = block_q or _pick_block(sq, cap=1024 if sq <= 1024 else 512)
+    bk = block_k or _pick_block(sk, cap=1024)
+    return bq, bk
+
+
+def _compiler_params(grid_len):
+    # first axes (batch*head and the parallel block axis) are
+    # order-independent; the innermost axis carries the online-softmax /
+    # accumulator recurrence and must stay sequential
+    return pltpu.CompilerParams(
+        dimension_semantics=("parallel",) * (grid_len - 1) + ("arbitrary",))
 
 
 def _flatten_bh(x):
@@ -249,10 +356,11 @@ def _flatten_bh(x):
     return x.reshape(b * h, s, d)
 
 
-def _fwd_impl(q, k, v, scale, causal, dropout_rate=0.0, seed=None):
+def _fwd_impl(q, k, v, scale, causal, dropout_rate=0.0, seed=None,
+              block_q=None, block_k=None):
     b, h, sq, d = q.shape
     sk = k.shape[2]
-    bq, bk = _pick_block(sq), _pick_block(sk)
+    bq, bk = _resolve_blocks(sq, sk, block_q, block_k)
     qf, kf, vf = _flatten_bh(q), _flatten_bh(k), _flatten_bh(v)
     bh = b * h
     nq, nk = sq // bq, sk // bk
@@ -270,40 +378,82 @@ def _fwd_impl(q, k, v, scale, causal, dropout_rate=0.0, seed=None):
         ],
         out_specs=[
             pl.BlockSpec((1, bq, d), lambda i, j, t: (i, j, 0)),
-            pl.BlockSpec((1, bq, 1), lambda i, j, t: (i, j, 0)),
+            # lse as (bh, nq, bq): one whole-head block resident per i
+            # (a (bh, sq, 1) fp32 array would tile-pad to 128x its
+            # size; 2-D (1, bq) blocks violate the (8, 128) tile rule)
+            pl.BlockSpec((1, nq, bq), lambda i, j, t: (i, 0, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, sq, 1), jnp.float32),
+            jax.ShapeDtypeStruct((bh, nq, bq), jnp.float32),
         ],
         scratch_shapes=[
-            pltpu.VMEM((bq, 1), jnp.float32),
-            pltpu.VMEM((bq, 1), jnp.float32),
-            pltpu.VMEM((bq, d), jnp.float32),
+            pltpu.VMEM((1, bq), jnp.float32),
+            pltpu.VMEM((1, bq), jnp.float32),
+            pltpu.VMEM((d, bq), jnp.float32),
         ],
+        # the q-block axis must stay sequential here: the whole-head lse
+        # block is shared across j, and a Megacore split of a "parallel"
+        # j would give each core a private copy with half the rows
+        # written (last flush wins)
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary", "arbitrary")),
         interpret=pallas_interpret(),
     )(qf, kf, vf, seed)
-    return o.reshape(b, h, sq, d), lse.reshape(b, h, sq, 1)
+    return o.reshape(b, h, sq, d), lse.reshape(b, h, sq)
+
+
+def _head_row_spec(nq, bq):
+    """Whole-head (1, nq, bq) block for the lse/delta row stats —
+    resident across the block loops (index depends only on i, whatever
+    the grid order)."""
+    return pl.BlockSpec((1, nq, bq), lambda i, *_: (i, 0, 0))
 
 
 def _bwd_impl(q, k, v, o, lse, do, scale, causal, dropout_rate=0.0,
-              seed=None):
+              seed=None, block_q=None, block_k=None):
     b, h, sq, d = q.shape
     sk = k.shape[2]
-    bq, bk = _pick_block(sq), _pick_block(sk)
+    bq, bk = _resolve_blocks(sq, sk, block_q, block_k)
     nq, nk = sq // bq, sk // bk
     bh = b * h
     if seed is None:
         seed = jnp.zeros((1, 1), jnp.int32)
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
-                    axis=-1, keepdims=True)  # (b,h,sq,1)
+                    axis=-1)  # (b,h,sq)
     args = [_flatten_bh(q), _flatten_bh(k), _flatten_bh(v),
-            _flatten_bh(do), lse.reshape(bh, sq, 1),
-            delta.reshape(bh, sq, 1), seed]
+            _flatten_bh(do), lse.reshape(bh, nq, bq),
+            delta.reshape(bh, nq, bq), seed]
     qspec = pl.BlockSpec((1, bq, d), lambda i, j, t: (i, j, 0))
     kspec = pl.BlockSpec((1, bk, d), lambda i, j, t: (i, t, 0))
-    r1 = pl.BlockSpec((1, bq, 1), lambda i, j, t: (i, j, 0))
+    r1 = _head_row_spec(nq, bq)
     sspec1 = pl.BlockSpec((1, 1), lambda i, j, t: (0, 0))
+
+    # single-pass fused backward while the full-(sk, d) dk/dv scratch
+    # fits VMEM comfortably; two-kernel fallback for long context
+    if sk * d <= 256 * 1024:
+        dq, dk, dv = pl.pallas_call(
+            functools.partial(_bwd_fused_kernel, scale=scale, causal=causal,
+                              bq=bq, bk=bk, nq=nq, nk=nk,
+                              dropout_rate=dropout_rate),
+            grid=(bh, nq, nk),
+            in_specs=[qspec, kspec, kspec, qspec, r1, r1, sspec1],
+            out_specs=[qspec, kspec, kspec],
+            out_shape=[jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+                       jax.ShapeDtypeStruct((bh, sk, d), k.dtype),
+                       jax.ShapeDtypeStruct((bh, sk, d), v.dtype)],
+            scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32),
+                            pltpu.VMEM((sk, d), jnp.float32),
+                            pltpu.VMEM((sk, d), jnp.float32)],
+            # dk/dv accumulate across the q-block axis too, so only the
+            # leading batch*head axis is order-independent here
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("parallel", "arbitrary", "arbitrary")),
+            interpret=pallas_interpret(),
+        )(*args)
+        return (dq.reshape(q.shape), dk.reshape(k.shape),
+                dv.reshape(v.shape))
+
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
                           bq=bq, bk=bk, nk=nk, dropout_rate=dropout_rate),
@@ -312,12 +462,13 @@ def _bwd_impl(q, k, v, o, lse, do, scale, causal, dropout_rate=0.0,
         out_specs=qspec,
         out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        compiler_params=_compiler_params(3),
         interpret=pallas_interpret(),
     )(*args)
     # dkv grid: k blocks outer, q blocks inner-sequential
     qspec2 = pl.BlockSpec((1, bq, d), lambda i, t, j: (i, j, 0))
     kspec2 = pl.BlockSpec((1, bk, d), lambda i, t, j: (i, t, 0))
-    r2 = pl.BlockSpec((1, bq, 1), lambda i, t, j: (i, j, 0))
+    r2 = _head_row_spec(nq, bq)
     sspec2 = pl.BlockSpec((1, 1), lambda i, t, j: (0, 0))
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
@@ -329,26 +480,29 @@ def _bwd_impl(q, k, v, o, lse, do, scale, causal, dropout_rate=0.0,
                    jax.ShapeDtypeStruct((bh, sk, d), v.dtype)],
         scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
                         pltpu.VMEM((bk, d), jnp.float32)],
+        compiler_params=_compiler_params(3),
         interpret=pallas_interpret(),
     )(*args)
     return (dq.reshape(q.shape), dk.reshape(k.shape), dv.reshape(v.shape))
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
-def _flash(q, k, v, scale, causal, dropout_rate, seed):
-    o, _ = _fwd_impl(q, k, v, scale, causal, dropout_rate, seed)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, scale, causal, dropout_rate, block_q, block_k, seed):
+    o, _ = _fwd_impl(q, k, v, scale, causal, dropout_rate, seed,
+                     block_q, block_k)
     return o
 
 
-def _flash_fwd(q, k, v, scale, causal, dropout_rate, seed):
-    o, lse = _fwd_impl(q, k, v, scale, causal, dropout_rate, seed)
+def _flash_fwd(q, k, v, scale, causal, dropout_rate, block_q, block_k, seed):
+    o, lse = _fwd_impl(q, k, v, scale, causal, dropout_rate, seed,
+                       block_q, block_k)
     return o, (q, k, v, o, lse, seed)
 
 
-def _flash_bwd(scale, causal, dropout_rate, res, do):
+def _flash_bwd(scale, causal, dropout_rate, block_q, block_k, res, do):
     q, k, v, o, lse, seed = res
     dq, dk, dv = _bwd_impl(q, k, v, o, lse, do, scale, causal,
-                           dropout_rate, seed)
+                           dropout_rate, seed, block_q, block_k)
     import numpy as _np
     dseed = _np.zeros(seed.shape, dtype=jax.dtypes.float0)
     return dq, dk, dv, dseed
@@ -363,6 +517,8 @@ def flash_attention(q, k, v, *, causal: bool = False,
                     softmax_scale: Optional[float] = None,
                     dropout_rate: float = 0.0,
                     dropout_key=None,
+                    block_q: Optional[int] = None,
+                    block_k: Optional[int] = None,
                     use_pallas_override: Optional[bool] = None):
     """Flash attention over (batch, heads, seq, head_dim).
 
@@ -397,7 +553,8 @@ def flash_attention(q, k, v, *, causal: bool = False,
                                       dtype=jnp.int32)
         else:
             seed = jnp.zeros((1, 1), jnp.int32)
-        return _flash(q, k, v, scale, causal, float(dropout_rate), seed)
+        return _flash(q, k, v, scale, causal, float(dropout_rate),
+                      block_q, block_k, seed)
     return attention_reference(q, k, v, causal=causal, softmax_scale=scale,
                                dropout_rate=dropout_rate,
                                dropout_key=dropout_key)

@@ -1,0 +1,79 @@
+"""Scratch: step anatomy fwd vs fwd+bwd vs full step (delete after)."""
+import time
+import jax, jax.numpy as jnp
+import numpy as np
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu.models.gpt import GPT, GPTConfig
+from apex_tpu.optimizers.fused_adam import FusedAdam
+from apex_tpu.parallel import mesh as M
+from apex_tpu.transformer.training import init_sharded_optimizer, make_tp_dp_train_step
+from apex_tpu.optimizers import flat as F
+
+
+def t_loop(fn, args, iters=10, meas=3):
+    out = fn(*args)
+    np.asarray(jax.tree.leaves(out)[0].ravel()[0])
+    best = float("inf")
+    for _ in range(meas):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = fn(*args)
+        np.asarray(jax.tree.leaves(out)[0].ravel()[0])
+        best = min(best, (time.perf_counter() - t0) / iters)
+    return best
+
+
+def main():
+    seq, batch = 1024, 8
+    M.destroy_model_parallel()
+    mesh = M.initialize_model_parallel(devices=jax.devices()[:1])
+    cfg = GPTConfig(vocab_size=50304, seq_len=seq, hidden=1024,
+                    num_layers=24, num_heads=16, dropout=0.0,
+                    dtype=jnp.bfloat16, remat=False, use_flash_attention=True)
+    model = GPT(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+    opt = FusedAdam(lr=1e-4, use_pallas=True)
+    opt_state = init_sharded_optimizer(opt, model, params, mesh)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (batch, seq), 0, 50304)
+    labels = jnp.roll(tokens, -1, axis=1)
+
+    specs = model.partition_specs()
+    fwd = jax.jit(shard_map(model.loss, mesh=mesh,
+                            in_specs=(specs, P(), P()), out_specs=P(),
+                            check_vma=False))
+    print(f"fwd loss        : {t_loop(fwd, (params, tokens, labels))*1e3:7.1f} ms", flush=True)
+
+    def fb(p, t, l):
+        return jax.value_and_grad(lambda pp: model.loss(pp, t, l))(p)
+    fbj = jax.jit(shard_map(fb, mesh=mesh, in_specs=(specs, P(), P()),
+                            out_specs=(P(), specs), check_vma=False))
+    print(f"fwd+bwd         : {t_loop(fbj, (params, tokens, labels))*1e3:7.1f} ms", flush=True)
+
+    # fwd+bwd from flat params (incl unflatten + grads as leaves)
+    def fb_flat(flatp, t, l):
+        p = F.unflatten(flatp, opt.spec)
+        return jax.value_and_grad(lambda pp: model.loss(pp, t, l))(p)
+    fbf = jax.jit(shard_map(fb_flat, mesh=mesh,
+                            in_specs=(P(("pp", "tp")), P(), P()),
+                            out_specs=(P(), specs), check_vma=False))
+    print(f"fwd+bwd w/unflat: {t_loop(fbf, (opt_state.params, tokens, labels))*1e3:7.1f} ms", flush=True)
+
+    step = make_tp_dp_train_step(model, opt, mesh, donate=True)
+    del params
+    for _ in range(3):
+        opt_state, loss = step(opt_state, tokens, labels)
+    _ = np.asarray(loss)
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(10):
+            opt_state, loss = step(opt_state, tokens, labels)
+        _ = np.asarray(loss)
+        best = min(best, (time.perf_counter() - t0) / 10)
+    print(f"full step       : {best*1e3:7.1f} ms -> {batch*seq/best:,.0f} tok/s", flush=True)
+
+
+if __name__ == "__main__":
+    main()

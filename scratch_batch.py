@@ -1,0 +1,43 @@
+"""Scratch: batch-size sweep for the bench config (delete after)."""
+import sys, time
+import jax, jax.numpy as jnp
+import numpy as np
+
+def run(batch, remat=False):
+    from apex_tpu.models.gpt import GPT, GPTConfig
+    from apex_tpu.optimizers.fused_adam import FusedAdam
+    from apex_tpu.parallel import mesh as M
+    from apex_tpu.transformer.training import init_sharded_optimizer, make_tp_dp_train_step
+    seq = 1024
+    M.destroy_model_parallel()
+    mesh = M.initialize_model_parallel(devices=jax.devices()[:1])
+    cfg = GPTConfig(vocab_size=50304, seq_len=seq, hidden=1024,
+                    num_layers=24, num_heads=16, dropout=0.0,
+                    dtype=jnp.bfloat16, remat=remat,
+                    use_flash_attention=True)
+    model = GPT(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+    opt = FusedAdam(lr=1e-4, use_pallas=True)
+    opt_state = init_sharded_optimizer(opt, model, params, mesh)
+    step = make_tp_dp_train_step(model, opt, mesh, donate=True)
+    del params
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (batch, seq), 0, 50304)
+    labels = jnp.roll(tokens, -1, axis=1)
+    for _ in range(3):
+        opt_state, loss = step(opt_state, tokens, labels)
+    _ = np.asarray(loss)
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(8):
+            opt_state, loss = step(opt_state, tokens, labels)
+        _ = np.asarray(loss)
+        best = min(best, (time.perf_counter() - t0) / 8)
+    print(f"batch={batch} remat={remat}: {best*1e3:7.1f} ms -> {batch*seq/best:,.0f} tok/s", flush=True)
+
+if __name__ == "__main__":
+    for b in sys.argv[1:]:
+        if b.endswith("r"):
+            run(int(b[:-1]), remat=True)
+        else:
+            run(int(b))

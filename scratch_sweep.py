@@ -1,0 +1,67 @@
+"""Scratch: flash-attention block sweep on the real chip (delete after)."""
+import time, functools
+import jax, jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+
+def timeit_injit(make_fn, reps=20, iters=5, meas=5):
+    """make_fn() -> (step, x0): step chained inside lax.scan; min of `meas`."""
+    step, x0 = make_fn()
+
+    @jax.jit
+    def run(x):
+        def body(c, _):
+            return step(c), None
+        c, _ = lax.scan(body, x, None, length=reps)
+        return jax.tree.map(lambda t: t.ravel()[0].astype(jnp.float32), c)
+
+    out = run(x0)
+    np.asarray(jax.tree.leaves(out)[0])
+    best = float("inf")
+    for _ in range(meas):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = run(x0)
+        np.asarray(jax.tree.leaves(out)[0])
+        best = min(best, (time.perf_counter() - t0) / (iters * reps))
+    return best
+
+
+def main():
+    from apex_tpu.ops.flash_attention import flash_attention
+    B, H, S, D = 8, 16, 1024, 64
+    k1, k2, k3 = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(k1, (B, H, S, D), jnp.bfloat16)
+    k = jax.random.normal(k2, (B, H, S, D), jnp.bfloat16)
+    v = jax.random.normal(k3, (B, H, S, D), jnp.bfloat16)
+    flops_c = 4 * B * H * S * S * D / 2  # causal
+
+    import sys
+    configs = [(512, 512), (512, 1024), (1024, 1024), (256, 1024),
+               (128, 1024), (1024, 512)]
+    lo, hi = int(sys.argv[1]), int(sys.argv[2])
+    for bq, bk in configs[lo:hi]:
+        fn = functools.partial(flash_attention, causal=True,
+                               block_q=bq, block_k=bk)
+
+        def mk_fwd():
+            return (lambda x: fn(x, k, v)), q
+        dt = timeit_injit(mk_fwd)
+        tf = flops_c / dt / 1e12
+
+        def mk_fb():
+            g = jax.grad(lambda qq, kk, vv: fn(qq, kk, vv).astype(
+                jnp.float32).mean(), argnums=(0, 1, 2))
+
+            def step(c):
+                dq, dk, dv = g(*c)
+                return (dq.astype(jnp.bfloat16), dk.astype(jnp.bfloat16),
+                        dv.astype(jnp.bfloat16))
+            return step, (q, k, v)
+        dtb = timeit_injit(mk_fb)
+        print(f"bq={bq:4d} bk={bk:4d}: fwd {dt*1e3:6.3f} ms ({tf:5.1f} TF/s causal-adj)  f+b {dtb*1e3:6.3f} ms", flush=True)
+
+
+if __name__ == "__main__":
+    main()
