@@ -61,6 +61,12 @@ class GPTConfig:
     ffn_mult: int = 4
     dropout: float = 0.0
     dtype: Any = jnp.float32
+    # LM-head logits dtype: None keeps fp32 logits.  bf16 halves the
+    # (S, B, V) HBM traffic in fwd and bwd; the cross entropy upcasts to
+    # fp32 internally either way (≡ the reference xentropy_cuda, which
+    # consumes fp16 logits with fp32 internal math).  Opt-in so existing
+    # bf16 configs keep their fp32-logits numerics.
+    logits_dtype: Any = None
     sequence_parallel: bool = False
     use_flash_attention: bool = False
     remat: bool = False            # activation checkpointing per block
@@ -264,8 +270,10 @@ class GPT:
             h = gather_from_sequence_parallel_region(h, c.axis_name)
         w = params["embed"]["weight"]  # local (V/tp, H)
         x = copy_to_tensor_model_parallel_region(h, c.axis_name)
+        out_dtype = c.logits_dtype or jnp.float32
         return jnp.einsum("sbh,vh->sbv", x, w,
-                          preferred_element_type=jnp.float32)
+                          preferred_element_type=jnp.float32
+                          ).astype(out_dtype)
 
     def loss(self, params, tokens, labels, key=None):
         """Mean LM loss.  tokens/labels: (B, S) global."""

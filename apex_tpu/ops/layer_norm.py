@@ -24,7 +24,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.experimental import pallas as pl
 
-from apex_tpu.ops._common import pallas_interpret, row_block, use_pallas
+from apex_tpu.ops._common import (pallas_interpret, row_block, use_pallas,
+                                  use_pallas_fusable)
 
 
 # --------------------------- reference (jnp) path ---------------------------
@@ -231,7 +232,7 @@ def fused_layer_norm(x, weight=None, bias=None, eps: float = 1e-5,
                      use_pallas_override: Optional[bool] = None):
     """Fused affine/plain LayerNorm ≡ fused_layer_norm_affine /
     fused_layer_norm (apex/normalization/fused_layer_norm.py:168-201)."""
-    if use_pallas(use_pallas_override):
+    if use_pallas_fusable(use_pallas_override):
         return _norm(x, weight, bias, eps, False)
     return layer_norm_reference(x, weight, bias, eps)
 
@@ -240,7 +241,7 @@ def fused_rms_norm(x, weight=None, eps: float = 1e-5,
                    use_pallas_override: Optional[bool] = None):
     """Fused RMSNorm ≡ fused_rms_norm_affine / fused_rms_norm
     (apex/normalization/fused_layer_norm.py:189-201)."""
-    if use_pallas(use_pallas_override):
+    if use_pallas_fusable(use_pallas_override):
         return _norm(x, weight, None, eps, True)
     return rms_norm_reference(x, weight, eps)
 

@@ -38,8 +38,8 @@ def main():
         batch, seq = 8, 1024
         cfg = GPTConfig(vocab_size=50304, seq_len=seq, hidden=1024,
                         num_layers=24, num_heads=16, dropout=0.0,
-                        dtype=jnp.bfloat16, remat=False,
-                        use_flash_attention=True)
+                        dtype=jnp.bfloat16, logits_dtype=jnp.bfloat16,
+                        remat=False, use_flash_attention=True)
         iters, warmup = 20, 3
     else:  # CPU smoke mode
         batch, seq = 2, 64

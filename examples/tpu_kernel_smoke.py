@@ -42,11 +42,14 @@ def main():
     x = jax.random.normal(jax.random.PRNGKey(0), (256, 8, 1024), jnp.bfloat16)
     w = jnp.ones((1024,), jnp.float32)
     b = jnp.zeros((1024,), jnp.float32)
-    check("layer_norm", jax.jit(fused_layer_norm), x, w, b,
-          grad_of=lambda x, w, b: fused_layer_norm(x, w, b)
+    ln_kernel = lambda x, w, b: fused_layer_norm(
+        x, w, b, use_pallas_override=True)
+    rms_kernel = lambda x, w: fused_rms_norm(x, w, use_pallas_override=True)
+    check("layer_norm", jax.jit(ln_kernel), x, w, b,
+          grad_of=lambda x, w, b: ln_kernel(x, w, b)
           .astype(jnp.float32).sum())
-    check("rms_norm", jax.jit(fused_rms_norm), x, w,
-          grad_of=lambda x, w: fused_rms_norm(x, w)
+    check("rms_norm", jax.jit(rms_kernel), x, w,
+          grad_of=lambda x, w: rms_kernel(x, w)
           .astype(jnp.float32).sum())
 
     from apex_tpu.ops.softmax import (

@@ -1,0 +1,54 @@
+"""Scratch: A/B pallas LN vs XLA LN in full step; batch16+dots remat."""
+import sys, time
+import jax, jax.numpy as jnp
+import numpy as np
+
+
+def run(tag, batch, remat=False, remat_policy=None, no_pallas_ln=False):
+    if no_pallas_ln:
+        import apex_tpu.ops.layer_norm as LN
+        orig = LN.fused_layer_norm
+        LN.fused_layer_norm = lambda x, w=None, b=None, eps=1e-5, **kw: \
+            LN.layer_norm_reference(x, w, b, eps)
+        import apex_tpu.models.gpt as G
+        G.fused_layer_norm = LN.fused_layer_norm
+    from apex_tpu.models.gpt import GPT, GPTConfig
+    from apex_tpu.optimizers.fused_adam import FusedAdam
+    from apex_tpu.parallel import mesh as M
+    from apex_tpu.transformer.training import init_sharded_optimizer, make_tp_dp_train_step
+    seq = 1024
+    M.destroy_model_parallel()
+    mesh = M.initialize_model_parallel(devices=jax.devices()[:1])
+    cfg = GPTConfig(vocab_size=50304, seq_len=seq, hidden=1024,
+                    num_layers=24, num_heads=16, dropout=0.0,
+                    dtype=jnp.bfloat16, remat=remat, remat_policy=remat_policy,
+                    use_flash_attention=True)
+    model = GPT(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+    opt = FusedAdam(lr=1e-4, use_pallas=True)
+    opt_state = init_sharded_optimizer(opt, model, params, mesh)
+    step = make_tp_dp_train_step(model, opt, mesh, donate=True)
+    del params
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (batch, seq), 0, 50304)
+    labels = jnp.roll(tokens, -1, axis=1)
+    for _ in range(3):
+        opt_state, loss = step(opt_state, tokens, labels)
+    _ = np.asarray(loss)
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(8):
+            opt_state, loss = step(opt_state, tokens, labels)
+        _ = np.asarray(loss)
+        best = min(best, (time.perf_counter() - t0) / 8)
+    print(f"{tag}: {best*1e3:7.1f} ms -> {batch*seq/best:,.0f} tok/s", flush=True)
+
+
+if __name__ == "__main__":
+    mode = sys.argv[1]
+    if mode == "xla_ln":
+        run("xla-ln  b8", 8, no_pallas_ln=True)
+    elif mode == "b16dots":
+        run("b16 dots", 16, remat=True, remat_policy="dots")
+    elif mode == "base":
+        run("base b8", 8)
