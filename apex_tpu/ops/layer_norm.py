@@ -24,7 +24,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.experimental import pallas as pl
 
-from apex_tpu.ops._common import (pallas_interpret, row_block, use_pallas,
+from apex_tpu.ops._common import (pallas_interpret, row_block,
                                   use_pallas_fusable)
 
 
