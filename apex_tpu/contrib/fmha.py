@@ -11,16 +11,41 @@ import jax.numpy as jnp
 from apex_tpu.ops.flash_attention import flash_attention
 
 
+def segment_ids_from_cu_seqlens(cu_seqlens, seq_len: int):
+    """cu_seqlens (n+1,) cumulative boundaries of n packed sequences →
+    (1, seq_len) segment ids: the TPU-native form of the reference's
+    varlen packing (fmha_api.cpp:18-160).  Positions past the last
+    boundary get a fresh id (pad segment)."""
+    pos = jnp.arange(seq_len)
+    # id = number of boundaries <= pos (first sequence = 1, pads = n+1)
+    return jnp.sum(pos[None, :] >= jnp.asarray(cu_seqlens)[1:, None],
+                   axis=0, dtype=jnp.int32)[None, :] + 1
+
+
 class FMHAFun:
     """≡ fmha.FMHAFun: qkv packed (total_tokens, 3, h, d) + cu_seqlens.
     TPU version takes the padded dense layout (B, S, 3, h, d) — packing
-    is a CUDA memory trick; XLA prefers static shapes."""
+    into one row still works: pass `segment_ids` (or `cu_seqlens` for
+    B == 1) and cross-sequence/pad attention is masked in-kernel, so
+    packed tokens cost no cross attention (the reference's whole point).
+    """
 
     @staticmethod
-    def apply(qkv, causal=False, softmax_scale=None):
+    def apply(qkv, causal=False, softmax_scale=None, segment_ids=None,
+              cu_seqlens=None):
+        if cu_seqlens is not None:
+            if segment_ids is not None:
+                raise ValueError("pass segment_ids or cu_seqlens, not both")
+            if qkv.shape[0] != 1:
+                raise ValueError("cu_seqlens packing implies batch 1 "
+                                 "(one packed row); use segment_ids for "
+                                 "batched packing")
+            segment_ids = segment_ids_from_cu_seqlens(
+                cu_seqlens, qkv.shape[1])
         q, k, v = (qkv[:, :, i].transpose(0, 2, 1, 3) for i in range(3))
         o = flash_attention(q, k, v, causal=causal,
-                            softmax_scale=softmax_scale)
+                            softmax_scale=softmax_scale,
+                            segment_ids=segment_ids)
         return o.transpose(0, 2, 1, 3)
 
 
@@ -30,5 +55,7 @@ class FMHA:
     def __init__(self, causal: bool = False):
         self.causal = causal
 
-    def __call__(self, qkv, softmax_scale=None):
-        return FMHAFun.apply(qkv, self.causal, softmax_scale)
+    def __call__(self, qkv, softmax_scale=None, segment_ids=None,
+                 cu_seqlens=None):
+        return FMHAFun.apply(qkv, self.causal, softmax_scale,
+                             segment_ids, cu_seqlens)

@@ -90,25 +90,35 @@ class SelfMultiheadAttn:
               use_pallas_override):
         rate = self.dropout if (is_training and dropout_key is not None) \
             else 0.0
+        dkey = dropout_key if rate > 0 else None
+        common = dict(causal=False, softmax_scale=self.scaling,
+                      dropout_rate=rate, dropout_key=dkey,
+                      use_pallas_override=use_pallas_override)
         if mask is None:
             # dropout runs IN-kernel (counter-based mask, ≡ FMHA philox
             # dropout) so the no-mask path never materializes sq x sk
-            return flash_attention(q, k, v, causal=False,
-                                   softmax_scale=self.scaling,
-                                   dropout_rate=rate,
-                                   dropout_key=dropout_key if rate > 0
-                                   else None,
-                                   use_pallas_override=use_pallas_override)
-        # masked path: reference math (≡ MaskSoftmaxDropout,
-        # mask_softmax_dropout_func.py); mask is non-None here
-        from apex_tpu.ops._common import dropout as _dropout_fn
-        s = jnp.einsum("bnqd,bnkd->bnqk", q.astype(jnp.float32),
-                       k.astype(jnp.float32)) * self.scaling
-        s = jnp.where(mask, -10000.0, s)
-        p = jax.nn.softmax(s, axis=-1)
-        p = _dropout_fn(dropout_key, rate, p)
-        return jnp.einsum("bnqk,bnkd->bnqd", p,
-                          v.astype(jnp.float32)).astype(q.dtype)
+            return flash_attention(q, k, v, **common)
+        b, sq, sk = q.shape[0], q.shape[2], k.shape[2]
+        if mask.ndim == 2 and mask.shape == (b, sk):
+            # (B, Sk) True = padded, the reference's key-padding mask
+            # (self_multihead_attn.py unsqueezes it to (B,1,1,Sk)) →
+            # segment ids: queries share id 0 with real keys, pads get
+            # id 1 — still no sq x sk materialization.  When (B, Sk)
+            # and (Sq, Sk) coincide, key-padding (reference semantics)
+            # wins — pass a 4-D mask to disambiguate.
+            return flash_attention(
+                q, k, v,
+                q_segment_ids=jnp.zeros((b, sq), jnp.int32),
+                kv_segment_ids=mask.astype(jnp.int32), **common)
+        # any other mask broadcastable to (b, n, sq, sk) — (sq, sk),
+        # (n|1, sq, sk), (b|1, n|1, sq, sk) — becomes a fused additive
+        # -10000 bias (≡ softmax.cuh's x*scale + mask); the mask the
+        # caller built is already sq x sk-shaped, so the kernel adds no
+        # score materialization on top
+        while mask.ndim < 4:
+            mask = mask[None]
+        bias = jnp.where(mask, jnp.float32(-10000.0), jnp.float32(0.0))
+        return flash_attention(q, k, v, bias=bias, **common)
 
 
 class EncdecMultiheadAttn(SelfMultiheadAttn):

@@ -59,6 +59,80 @@ def _causal_mask(st, j, t, bq, bk):
     return jnp.where(krow > qcol, _NEG_INF, st)
 
 
+def _mask_bias(st, j, t, bq, bk, causal_masked, has_bias, bias_ref,
+               has_seg, qseg_ref, kseg_ref):
+    """Apply (in order) additive bias, segment mask, causal mask to a
+    TRANSPOSED (bk, bq) score block.
+
+    ≡ the reference's additive-mask softmax fusion
+    (apex/contrib/csrc/multihead_attn/softmax.cuh:27-200 computes
+    x*scale + mask in-kernel) and the fmha varlen packing
+    (fmha_api.cpp:18-160's cu_seqlens): segment ids are the TPU-native
+    varlen — tokens attend only within equal ids, so packed sequences
+    and padding cost no cross-attention."""
+    if has_bias:
+        st = st + bias_ref[0, 0]                        # (bk, bq)
+    if has_seg:
+        qs = qseg_ref[0, j]                             # (bq,) lanes
+        ks = kseg_ref[0, t].reshape(bk, 1)              # (bk, 1) sublanes
+        st = jnp.where(ks != qs, _NEG_INF, st)
+    if causal_masked:
+        st = _causal_mask(st, j, t, bq, bk)
+    return st
+
+
+def _extras_arrays(b, h, sq, sk, nq, bq, nk, bk, bias, q_seg, kv_seg):
+    """Host-side packing of the optional bias / segment-id operands.
+
+    bias: broadcastable (nb in {1,b}, nh in {1,h}, sq, sk) — passed to
+    the kernels TRANSPOSED as (nb, nh, sk, sq) so score blocks need no
+    per-step transpose.  Segment ids: (b, s) int32, reshaped to
+    (b, n_blocks, block) whole-row-resident blocks.  Absent operands
+    ride as (1,1,1,1)/(1,1,1) dummies (static has_* flags gate every
+    kernel read)."""
+    if bias is not None:
+        nb, nh = bias.shape[0], bias.shape[1]
+        # broadcast-1 sq/sk dims expand HERE (inside fwd/bwd impls, not
+        # before the custom_vjp) so the VJP residuals keep the caller's
+        # compact bias; batch/head broadcasting stays in the index map.
+        # NOTE a (.., 1, sk) pad bias still expands to sq*sk transiently
+        # — prefer segment_ids for pure padding (no S^2 anything)
+        bias_t = jnp.broadcast_to(
+            jnp.swapaxes(bias.astype(jnp.float32), 2, 3),
+            (nb, nh, sk, sq))
+    else:
+        nb = nh = 1
+        bias_t = jnp.zeros((1, 1, 1, 1), jnp.float32)
+    if q_seg is not None:
+        qs = q_seg.astype(jnp.int32).reshape(b, nq, bq)
+        ks = kv_seg.astype(jnp.int32).reshape(b, nk, bk)
+    else:
+        qs = jnp.zeros((1, 1, 1), jnp.int32)
+        ks = jnp.zeros((1, 1, 1), jnp.int32)
+    return bias_t, qs, ks
+
+
+def _extras_specs(h, nq, bq, nk, bk, has_bias, nb, nh, has_seg, *,
+                  jt_from_args):
+    """BlockSpecs for (bias_t, q_seg, kv_seg).  `jt_from_args` maps the
+    grid args after i to (j, t) — grids differ in block order."""
+    if has_bias:
+        def bias_idx(i, *rest):
+            j, t = jt_from_args(*rest)
+            return (i // h if nb > 1 else 0,
+                    i % h if nh > 1 else 0, t, j)
+        bspec = pl.BlockSpec((1, 1, bk, bq), bias_idx)
+    else:
+        bspec = pl.BlockSpec((1, 1, 1, 1), lambda i, *_: (0, 0, 0, 0))
+    if has_seg:
+        qspec = pl.BlockSpec((1, nq, bq), lambda i, *_: (i // h, 0, 0))
+        kspec = pl.BlockSpec((1, nk, bk), lambda i, *_: (i // h, 0, 0))
+    else:
+        qspec = pl.BlockSpec((1, 1, 1), lambda i, *_: (0, 0, 0))
+        kspec = pl.BlockSpec((1, 1, 1), lambda i, *_: (0, 0, 0))
+    return bspec, qspec, kspec
+
+
 def _dropout_keep(seed_ref, i, j, t, shape, rate):
     """Deterministic per-score-block keep mask.
 
@@ -86,7 +160,8 @@ def _dropout_keep(seed_ref, i, j, t, shape, rate):
 # --------------------------- reference (jnp) path ---------------------------
 
 def attention_reference(q, k, v, *, causal=False, softmax_scale=None,
-                        bias=None, dropout_rate=0.0, dropout_key=None):
+                        bias=None, q_segment_ids=None, kv_segment_ids=None,
+                        dropout_rate=0.0, dropout_key=None):
     """Plain softmax attention, fp32 accumulation (the parity oracle,
     ≡ the python fallback paths in apex/contrib/multihead_attn).
     Dropout masks the post-softmax attention weights (bernoulli stream —
@@ -97,6 +172,10 @@ def attention_reference(q, k, v, *, causal=False, softmax_scale=None,
                    k.astype(jnp.float32)) * scale
     if bias is not None:
         s = s + bias.astype(jnp.float32)
+    if q_segment_ids is not None:
+        seg = (q_segment_ids[:, None, :, None]
+               != kv_segment_ids[:, None, None, :])  # (b, 1, sq, sk)
+        s = jnp.where(seg, _NEG_INF, s)
     if causal:
         sq, sk = s.shape[-2], s.shape[-1]
         mask = jnp.triu(jnp.ones((sq, sk), bool), k=1)
@@ -109,9 +188,10 @@ def attention_reference(q, k, v, *, causal=False, softmax_scale=None,
 
 # ------------------------------ forward kernel ------------------------------
 
-def _fwd_kernel(q_ref, k_ref, v_ref, seed_ref, o_ref, lse_ref,
+def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, qseg_ref, kseg_ref,
+                seed_ref, o_ref, lse_ref,
                 m_scr, l_scr, acc_scr, *, scale, causal, bq, bk, nk,
-                dropout_rate):
+                dropout_rate, has_bias, has_seg):
     """Scores run TRANSPOSED (bk, bq): the softmax statistics (m, l,
     lse) are then (1, bq) lane-major rows — fully-packed vregs instead
     of 1/128-occupied columns, and the lse/delta HBM arrays are
@@ -133,8 +213,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, seed_ref, o_ref, lse_ref,
         st = jax.lax.dot_general(k_ref[0], q_ref[0],
                                  (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32) * scale
-        if masked:
-            st = _causal_mask(st, j, t, bq, bk)
+        st = _mask_bias(st, j, t, bq, bk, masked, has_bias, bias_ref,
+                        has_seg, qseg_ref, kseg_ref)
         m_prev = m_scr[...]                                     # (1, bq)
         m_new = jnp.maximum(m_prev, jnp.max(st, axis=0, keepdims=True))
         p = jnp.exp(st - m_new)                                 # (bk, bq)
@@ -168,8 +248,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, seed_ref, o_ref, lse_ref,
 # ------------------------------ backward kernels ----------------------------
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                   bias_ref, qseg_ref, kseg_ref,
                    seed_ref, dq_ref, dq_scr, *, scale, causal, bq, bk, nk,
-                   dropout_rate):
+                   dropout_rate, has_bias, has_seg):
     i = pl.program_id(0)
     j = pl.program_id(1)
     t = pl.program_id(2)
@@ -183,8 +264,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         st = jax.lax.dot_general(k_ref[0], q_ref[0],
                                  (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32) * scale
-        if masked:
-            st = _causal_mask(st, j, t, bq, bk)
+        st = _mask_bias(st, j, t, bq, bk, masked, has_bias, bias_ref,
+                        has_seg, qseg_ref, kseg_ref)
         p = jnp.exp(st - lse_ref[0, j])                         # (bk, bq)
         dp = jax.lax.dot_general(v_ref[0], do_ref[0],
                                  (((1,), (1,)), ((), ())),
@@ -206,8 +287,9 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    bias_ref, qseg_ref, kseg_ref,
                     seed_ref, dk_ref, dv_ref, dk_scr, dv_scr, *, scale,
-                    causal, bq, bk, nq, dropout_rate):
+                    causal, bq, bk, nq, dropout_rate, has_bias, has_seg):
     i = pl.program_id(0)
     t = pl.program_id(1)  # k block
     j = pl.program_id(2)  # q block (sequential inner)
@@ -222,8 +304,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         st = jax.lax.dot_general(k_ref[0], q_ref[0],
                                  (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32) * scale
-        if masked:
-            st = _causal_mask(st, j, t, bq, bk)
+        st = _mask_bias(st, j, t, bq, bk, masked, has_bias, bias_ref,
+                        has_seg, qseg_ref, kseg_ref)
         p = jnp.exp(st - lse_ref[0, j])                 # (bk, bq)
         if dropout_rate > 0.0:
             keep = _dropout_keep(seed_ref, i, j, t, (bk, bq), dropout_rate)
@@ -253,9 +335,10 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                      bias_ref, qseg_ref, kseg_ref,
                       seed_ref, dq_ref, dk_ref, dv_ref,
                       dq_scr, dk_scr, dv_scr, *, scale, causal, bq, bk,
-                      nq, nk, dropout_rate):
+                      nq, nk, dropout_rate, has_bias, has_seg):
     """Single-pass backward: dq, dk, dv from ONE score/exp recompute.
 
     The two-kernel split recomputes st/p twice (7 matmuls + 2 exp
@@ -282,8 +365,8 @@ def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         st = jax.lax.dot_general(k_ref[0], q_ref[0],
                                  (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32) * scale
-        if masked:
-            st = _causal_mask(st, j, t, bq, bk)
+        st = _mask_bias(st, j, t, bq, bk, masked, has_bias, bias_ref,
+                        has_seg, qseg_ref, kseg_ref)
         p = jnp.exp(st - lse_ref[0, j])                 # (bk, bq)
         dp = jax.lax.dot_general(v_ref[0], do_ref[0],
                                  (((1,), (1,)), ((), ())),
@@ -328,17 +411,19 @@ def _pick_block(seq, cap=512):
     return None
 
 
-def _resolve_blocks(sq, sk, block_q, block_k):
+def _resolve_blocks(sq, sk, block_q, block_k, has_bias=False):
     """Default blocks, swept on v5e (docs/PERF.md): single block per
     axis when the sequence fits (<=1024 — grid overhead dominates the
     extra causal-mask work), else (512, 1024) to cap the fp32 score
     tile at 2 MB of VMEM while keeping k-side matmuls wide.  Explicit
-    blocks must divide the sequence."""
+    blocks must divide the sequence.  A fused bias adds a same-size
+    fp32 block, so the q block is halved to stay inside VMEM."""
     if block_q is not None and sq % block_q:
         raise ValueError(f"block_q={block_q} does not divide sq={sq}")
     if block_k is not None and sk % block_k:
         raise ValueError(f"block_k={block_k} does not divide sk={sk}")
-    bq = block_q or _pick_block(sq, cap=1024 if sq <= 1024 else 512)
+    q_cap = 1024 if (sq <= 1024 and not has_bias) else 512
+    bq = block_q or _pick_block(sq, cap=q_cap)
     bk = block_k or _pick_block(sk, cap=1024)
     return bq, bk
 
@@ -357,23 +442,35 @@ def _flatten_bh(x):
 
 
 def _fwd_impl(q, k, v, scale, causal, dropout_rate=0.0, seed=None,
-              block_q=None, block_k=None):
+              block_q=None, block_k=None, bias=None, q_seg=None,
+              kv_seg=None):
     b, h, sq, d = q.shape
     sk = k.shape[2]
-    bq, bk = _resolve_blocks(sq, sk, block_q, block_k)
+    bq, bk = _resolve_blocks(sq, sk, block_q, block_k,
+                              has_bias=bias is not None)
     qf, kf, vf = _flatten_bh(q), _flatten_bh(k), _flatten_bh(v)
     bh = b * h
     nq, nk = sq // bq, sk // bk
     if seed is None:
         seed = jnp.zeros((1, 1), jnp.int32)
+    has_bias, has_seg = bias is not None, q_seg is not None
+    nb = bias.shape[0] if has_bias else 1
+    nh = bias.shape[1] if has_bias else 1
+    bias_t, qs, ks = _extras_arrays(b, h, sq, sk, nq, bq, nk, bk,
+                                    bias, q_seg, kv_seg)
+    bspec, qsspec, ksspec = _extras_specs(
+        h, nq, bq, nk, bk, has_bias, nb, nh, has_seg,
+        jt_from_args=lambda j, t: (j, t))
     o, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, scale=scale, causal=causal, bq=bq,
-                          bk=bk, nk=nk, dropout_rate=dropout_rate),
+                          bk=bk, nk=nk, dropout_rate=dropout_rate,
+                          has_bias=has_bias, has_seg=has_seg),
         grid=(bh, nq, nk),
         in_specs=[
             pl.BlockSpec((1, bq, d), lambda i, j, t: (i, j, 0)),
             pl.BlockSpec((1, bk, d), lambda i, j, t: (i, t, 0)),
             pl.BlockSpec((1, bk, d), lambda i, j, t: (i, t, 0)),
+            bspec, qsspec, ksspec,
             pl.BlockSpec((1, 1), lambda i, j, t: (0, 0)),
         ],
         out_specs=[
@@ -399,7 +496,7 @@ def _fwd_impl(q, k, v, scale, causal, dropout_rate=0.0, seed=None,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "arbitrary", "arbitrary")),
         interpret=pallas_interpret(),
-    )(qf, kf, vf, seed)
+    )(qf, kf, vf, bias_t, qs, ks, seed)
     return o.reshape(b, h, sq, d), lse.reshape(b, h, sq)
 
 
@@ -411,19 +508,32 @@ def _head_row_spec(nq, bq):
 
 
 def _bwd_impl(q, k, v, o, lse, do, scale, causal, dropout_rate=0.0,
-              seed=None, block_q=None, block_k=None):
+              seed=None, block_q=None, block_k=None, bias=None,
+              q_seg=None, kv_seg=None):
     b, h, sq, d = q.shape
     sk = k.shape[2]
-    bq, bk = _resolve_blocks(sq, sk, block_q, block_k)
+    bq, bk = _resolve_blocks(sq, sk, block_q, block_k,
+                              has_bias=bias is not None)
     nq, nk = sq // bq, sk // bk
     bh = b * h
     if seed is None:
         seed = jnp.zeros((1, 1), jnp.int32)
+    has_bias, has_seg = bias is not None, q_seg is not None
+    nb = bias.shape[0] if has_bias else 1
+    nh = bias.shape[1] if has_bias else 1
+    bias_t, qsegs, ksegs = _extras_arrays(b, h, sq, sk, nq, bq, nk, bk,
+                                          bias, q_seg, kv_seg)
+    bspec, qsspec, ksspec = _extras_specs(
+        h, nq, bq, nk, bk, has_bias, nb, nh, has_seg,
+        jt_from_args=lambda j, t: (j, t))
+    static = dict(scale=scale, causal=causal, bq=bq, bk=bk,
+                  dropout_rate=dropout_rate, has_bias=has_bias,
+                  has_seg=has_seg)
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
                     axis=-1)  # (b,h,sq)
     args = [_flatten_bh(q), _flatten_bh(k), _flatten_bh(v),
             _flatten_bh(do), lse.reshape(bh, nq, bq),
-            delta.reshape(bh, nq, bq), seed]
+            delta.reshape(bh, nq, bq), bias_t, qsegs, ksegs, seed]
     qspec = pl.BlockSpec((1, bq, d), lambda i, j, t: (i, j, 0))
     kspec = pl.BlockSpec((1, bk, d), lambda i, j, t: (i, t, 0))
     r1 = _head_row_spec(nq, bq)
@@ -433,11 +543,10 @@ def _bwd_impl(q, k, v, o, lse, do, scale, causal, dropout_rate=0.0,
     # fits VMEM comfortably; two-kernel fallback for long context
     if sk * d <= 256 * 1024:
         dq, dk, dv = pl.pallas_call(
-            functools.partial(_bwd_fused_kernel, scale=scale, causal=causal,
-                              bq=bq, bk=bk, nq=nq, nk=nk,
-                              dropout_rate=dropout_rate),
+            functools.partial(_bwd_fused_kernel, nq=nq, nk=nk, **static),
             grid=(bh, nq, nk),
-            in_specs=[qspec, kspec, kspec, qspec, r1, r1, sspec1],
+            in_specs=[qspec, kspec, kspec, qspec, r1, r1,
+                      bspec, qsspec, ksspec, sspec1],
             out_specs=[qspec, kspec, kspec],
             out_shape=[jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
                        jax.ShapeDtypeStruct((bh, sk, d), k.dtype),
@@ -455,10 +564,10 @@ def _bwd_impl(q, k, v, o, lse, do, scale, causal, dropout_rate=0.0,
                 dv.reshape(v.shape))
 
     dq = pl.pallas_call(
-        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
-                          bq=bq, bk=bk, nk=nk, dropout_rate=dropout_rate),
+        functools.partial(_bwd_dq_kernel, nk=nk, **static),
         grid=(bh, nq, nk),
-        in_specs=[qspec, kspec, kspec, qspec, r1, r1, sspec1],
+        in_specs=[qspec, kspec, kspec, qspec, r1, r1,
+                  bspec, qsspec, ksspec, sspec1],
         out_specs=qspec,
         out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
@@ -470,11 +579,14 @@ def _bwd_impl(q, k, v, o, lse, do, scale, causal, dropout_rate=0.0,
     kspec2 = pl.BlockSpec((1, bk, d), lambda i, t, j: (i, t, 0))
     r2 = _head_row_spec(nq, bq)
     sspec2 = pl.BlockSpec((1, 1), lambda i, t, j: (0, 0))
+    bspec2, qsspec2, ksspec2 = _extras_specs(
+        h, nq, bq, nk, bk, has_bias, nb, nh, has_seg,
+        jt_from_args=lambda t, j: (j, t))
     dk, dv = pl.pallas_call(
-        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
-                          bq=bq, bk=bk, nq=nq, dropout_rate=dropout_rate),
+        functools.partial(_bwd_dkv_kernel, nq=nq, **static),
         grid=(bh, nk, nq),
-        in_specs=[qspec2, kspec2, kspec2, qspec2, r2, r2, sspec2],
+        in_specs=[qspec2, kspec2, kspec2, qspec2, r2, r2,
+                  bspec2, qsspec2, ksspec2, sspec2],
         out_specs=[kspec2, kspec2],
         out_shape=[jax.ShapeDtypeStruct((bh, sk, d), k.dtype),
                    jax.ShapeDtypeStruct((bh, sk, d), v.dtype)],
@@ -486,26 +598,37 @@ def _bwd_impl(q, k, v, o, lse, do, scale, causal, dropout_rate=0.0,
     return (dq.reshape(q.shape), dk.reshape(k.shape), dv.reshape(v.shape))
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash(q, k, v, scale, causal, dropout_rate, block_q, block_k, seed):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(6, 7, 8, 9, 10))
+def _flash(q, k, v, bias, q_seg, kv_seg, scale, causal, dropout_rate,
+           block_q, block_k, seed):
     o, _ = _fwd_impl(q, k, v, scale, causal, dropout_rate, seed,
-                     block_q, block_k)
+                     block_q, block_k, bias, q_seg, kv_seg)
     return o
 
 
-def _flash_fwd(q, k, v, scale, causal, dropout_rate, block_q, block_k, seed):
+def _flash_fwd(q, k, v, bias, q_seg, kv_seg, scale, causal, dropout_rate,
+               block_q, block_k, seed):
     o, lse = _fwd_impl(q, k, v, scale, causal, dropout_rate, seed,
-                       block_q, block_k)
-    return o, (q, k, v, o, lse, seed)
+                       block_q, block_k, bias, q_seg, kv_seg)
+    return o, (q, k, v, bias, q_seg, kv_seg, o, lse, seed)
 
 
 def _flash_bwd(scale, causal, dropout_rate, block_q, block_k, res, do):
-    q, k, v, o, lse, seed = res
+    q, k, v, bias, q_seg, kv_seg, o, lse, seed = res
     dq, dk, dv = _bwd_impl(q, k, v, o, lse, do, scale, causal,
-                           dropout_rate, seed, block_q, block_k)
+                           dropout_rate, seed, block_q, block_k,
+                           bias, q_seg, kv_seg)
     import numpy as _np
-    dseed = _np.zeros(seed.shape, dtype=jax.dtypes.float0)
-    return dq, dk, dv, dseed
+
+    def _int_zero(x):
+        return (None if x is None
+                else _np.zeros(x.shape, dtype=jax.dtypes.float0))
+    # bias is treated as a CONSTANT (padding masks, fixed position
+    # biases): its cotangent is zero by contract — see flash_attention's
+    # docstring
+    dbias = None if bias is None else jnp.zeros_like(bias)
+    return (dq, dk, dv, dbias, _int_zero(q_seg), _int_zero(kv_seg),
+            _int_zero(seed))
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -515,6 +638,10 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 
 def flash_attention(q, k, v, *, causal: bool = False,
                     softmax_scale: Optional[float] = None,
+                    bias=None,
+                    segment_ids=None,
+                    q_segment_ids=None,
+                    kv_segment_ids=None,
                     dropout_rate: float = 0.0,
                     dropout_key=None,
                     block_q: Optional[int] = None,
@@ -529,6 +656,22 @@ def flash_attention(q, k, v, *, causal: bool = False,
     regenerated in backward (≡ the reference's philox dropout,
     fmha/src/fmha/softmax.h) — no sq x sk mask ever reaches HBM, so
     dropout works at any sequence length.
+
+    bias: additive fp score bias, shape (b|1, h|1, sq, sk), fused into
+    the kernel (≡ the additive-mask softmax in
+    apex/contrib/csrc/multihead_attn/softmax.cuh:27-200).  It is
+    treated as a CONSTANT — its cotangent is defined as zero — which
+    covers padding masks, ALiBi slopes, and fixed relative-position
+    biases; a *trainable* bias must go through the dense reference
+    path.
+
+    segment_ids: (b, s) int — tokens attend only where ids are equal;
+    this is the TPU-native form of the reference fmha's cu_seqlens
+    varlen packing (fmha_api.cpp:18-160): pack multiple sequences into
+    one row with distinct ids and padded tokens cost no attention.
+    q_segment_ids/kv_segment_ids set the two sides separately (encdec
+    or kv-cache shapes); fully-masked query rows produce a uniform
+    attention over kv (like the dense oracle) — mask them in the loss.
     """
     d = q.shape[-1]
     scale = softmax_scale if softmax_scale is not None else 1.0 / math.sqrt(d)
@@ -536,6 +679,30 @@ def flash_attention(q, k, v, *, causal: bool = False,
         raise ValueError(f"dropout_rate must be in [0, 1), got {dropout_rate}")
     if dropout_rate > 0.0 and dropout_key is None:
         raise ValueError("dropout_rate > 0 requires dropout_key")
+    if segment_ids is not None:
+        if q_segment_ids is not None or kv_segment_ids is not None:
+            raise ValueError(
+                "pass either segment_ids or q_/kv_segment_ids, not both")
+        q_segment_ids = kv_segment_ids = segment_ids
+    if (q_segment_ids is None) != (kv_segment_ids is None):
+        raise ValueError("q_segment_ids and kv_segment_ids go together")
+    b, h = q.shape[0], q.shape[1]
+    sq, sk = q.shape[2], k.shape[2]
+    if bias is not None:
+        eb, eh = bias.shape[0], bias.shape[1]
+        if (bias.ndim != 4 or eb not in (1, b) or eh not in (1, h)
+                or bias.shape[2] not in (1, sq)
+                or bias.shape[3] not in (1, sk)):
+            raise ValueError(
+                f"bias shape {bias.shape} not broadcastable to "
+                f"({b}|1, {h}|1, {sq}|1, {sk}|1)")
+    if q_segment_ids is not None:
+        q_segment_ids = jnp.asarray(q_segment_ids, jnp.int32)
+        kv_segment_ids = jnp.asarray(kv_segment_ids, jnp.int32)
+        if q_segment_ids.shape != (b, sq) or kv_segment_ids.shape != (b, sk):
+            raise ValueError(
+                f"segment id shapes {q_segment_ids.shape}/"
+                f"{kv_segment_ids.shape} != ({b}, {sq})/({b}, {sk})")
     # the in-kernel dropout path needs the TPU hardware PRNG
     # (pltpu.prng_seed has no interpret-mode lowering)
     if (dropout_rate > 0.0 and use_pallas_override is True
@@ -553,8 +720,16 @@ def flash_attention(q, k, v, *, causal: bool = False,
                                       dtype=jnp.int32)
         else:
             seed = jnp.zeros((1, 1), jnp.int32)
-        return _flash(q, k, v, scale, causal, float(dropout_rate),
+        return _flash(q, k, v, bias, q_segment_ids, kv_segment_ids,
+                      scale, causal, float(dropout_rate),
                       block_q, block_k, seed)
+    # stop_gradient keeps the zero-dbias contract identical to the
+    # kernel path — a trainable bias must call attention_reference
+    # directly, on every backend
     return attention_reference(q, k, v, causal=causal, softmax_scale=scale,
+                               bias=(None if bias is None
+                                     else lax.stop_gradient(bias)),
+                               q_segment_ids=q_segment_ids,
+                               kv_segment_ids=kv_segment_ids,
                                dropout_rate=dropout_rate,
                                dropout_key=dropout_key)

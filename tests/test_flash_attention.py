@@ -107,3 +107,134 @@ def test_flash_dropout_fallback_api():
             dropout_key=jax.random.PRNGKey(50 + i)))
     rel = np.abs(acc / n - base).mean() / np.abs(base).mean()
     assert rel < 0.3, rel
+
+
+# ---------------- masks / bias / varlen (round 2: VERDICT missing #1-2) -----
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_segment_mask_parity(causal):
+    """Segment ids ≡ the reference's padding/attention masks
+    (multihead_attn mask paths) and fmha varlen cu_seqlens."""
+    b, h, s, d = 2, 2, 64, 16
+    q, k, v = _qkv(b, h, s, s, d, seed=3)
+    # two packed segments + a pad tail per row
+    seg = jnp.stack([
+        jnp.concatenate([jnp.zeros(24, jnp.int32), jnp.ones(24, jnp.int32),
+                         jnp.full((16,), 7, jnp.int32)]),
+        jnp.concatenate([jnp.zeros(40, jnp.int32),
+                         jnp.full((24,), 3, jnp.int32)]),
+    ])
+    got = flash_attention(q, k, v, causal=causal, segment_ids=seg,
+                          use_pallas_override=True)
+    want = attention_reference(q, k, v, causal=causal,
+                               q_segment_ids=seg, kv_segment_ids=seg)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_flash_segment_grads():
+    b, h, s, d = 1, 2, 64, 16
+    q, k, v = _qkv(b, h, s, s, d, seed=4)
+    seg = jnp.concatenate([jnp.zeros(32, jnp.int32),
+                           jnp.ones(32, jnp.int32)])[None, :]
+
+    def lf(q, k, v):
+        return jnp.sum(jnp.sin(flash_attention(
+            q, k, v, segment_ids=seg, use_pallas_override=True)))
+
+    def lr(q, k, v):
+        return jnp.sum(jnp.sin(attention_reference(
+            q, k, v, q_segment_ids=seg, kv_segment_ids=seg)))
+
+    g1 = jax.grad(lf, argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(lr, argnums=(0, 1, 2))(q, k, v)
+    for a, e, name in zip(g1, g2, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(e),
+                                   rtol=1e-3, atol=1e-3,
+                                   err_msg=f"d{name} mismatch")
+
+
+def test_flash_varlen_packing_equivalence():
+    """Two sequences packed into one row with distinct segment ids give
+    the same outputs as attending to each separately — the capability
+    fmha's cu_seqlens packing provides (fmha_api.cpp:18-160)."""
+    h, d = 2, 16
+    s1, s2 = 24, 40
+    q, k, v = _qkv(1, h, s1 + s2, s1 + s2, d, seed=5)
+    seg = jnp.concatenate([jnp.zeros(s1, jnp.int32),
+                           jnp.ones(s2, jnp.int32)])[None, :]
+    packed = flash_attention(q, k, v, causal=True, segment_ids=seg,
+                             use_pallas_override=True)
+    sep1 = attention_reference(q[:, :, :s1], k[:, :, :s1], v[:, :, :s1],
+                               causal=True)
+    sep2 = attention_reference(q[:, :, s1:], k[:, :, s1:], v[:, :, s1:],
+                               causal=True)
+    np.testing.assert_allclose(np.asarray(packed[:, :, :s1]),
+                               np.asarray(sep1), rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(packed[:, :, s1:]),
+                               np.asarray(sep2), rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.parametrize("bias_shape", [(1, 1), (1, 2), (2, 1), (2, 2)])
+def test_flash_additive_bias_parity(bias_shape):
+    """Additive score bias ≡ the fused x*scale + mask softmax
+    (multihead_attn/softmax.cuh:27-200); covers ALiBi/rel-pos masks."""
+    b, h, s, d = 2, 2, 64, 16
+    q, k, v = _qkv(b, h, s, s, d, seed=6)
+    nb, nh = bias_shape
+    bias = jax.random.normal(jax.random.PRNGKey(9), (nb, nh, s, s),
+                             jnp.float32)
+    got = flash_attention(q, k, v, bias=bias, use_pallas_override=True)
+    want = attention_reference(q, k, v, bias=bias)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_flash_bias_grads_qkv():
+    """q/k/v grads flow through a (constant) bias; dbias contract = 0."""
+    b, h, s, d = 1, 2, 32, 16
+    q, k, v = _qkv(b, h, s, s, d, seed=7)
+    bias = jax.random.normal(jax.random.PRNGKey(8), (1, h, s, s))
+
+    def lf(q, k, v):
+        return jnp.sum(jnp.sin(flash_attention(
+            q, k, v, bias=bias, use_pallas_override=True)))
+
+    def lr(q, k, v):
+        return jnp.sum(jnp.sin(attention_reference(q, k, v, bias=bias)))
+
+    g1 = jax.grad(lf, argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(lr, argnums=(0, 1, 2))(q, k, v)
+    for a, e, name in zip(g1, g2, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(e),
+                                   rtol=1e-3, atol=1e-3,
+                                   err_msg=f"d{name} mismatch")
+    dbias = jax.grad(lambda bb: jnp.sum(flash_attention(
+        q, k, v, bias=bb, use_pallas_override=True)))(bias)
+    assert float(jnp.max(jnp.abs(dbias))) == 0.0
+
+
+def test_flash_bias_with_segments_and_causal():
+    b, h, s, d = 1, 2, 64, 16
+    q, k, v = _qkv(b, h, s, s, d, seed=10)
+    bias = 0.1 * jax.random.normal(jax.random.PRNGKey(11), (1, 1, s, s))
+    seg = jnp.concatenate([jnp.zeros(48, jnp.int32),
+                           jnp.ones(16, jnp.int32)])[None, :]
+    got = flash_attention(q, k, v, causal=True, bias=bias, segment_ids=seg,
+                          use_pallas_override=True)
+    want = attention_reference(q, k, v, causal=True, bias=bias,
+                               q_segment_ids=seg, kv_segment_ids=seg)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_flash_segment_api_validation():
+    q, k, v = _qkv(1, 1, 32, 32, 8)
+    seg = jnp.zeros((1, 32), jnp.int32)
+    with pytest.raises(ValueError):
+        flash_attention(q, k, v, segment_ids=seg, q_segment_ids=seg,
+                        kv_segment_ids=seg)
+    with pytest.raises(ValueError):
+        flash_attention(q, k, v, q_segment_ids=seg)
+    with pytest.raises(ValueError):
+        flash_attention(q, k, v, bias=jnp.zeros((3, 1, 32, 32)))
