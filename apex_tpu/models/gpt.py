@@ -383,10 +383,6 @@ class GPTPipelined(GPT):
         def stage_fn(chunk_blocks, x, chunk):
             return self._stage_fn(chunk_blocks, x, chunk)
 
-        out = spmd_pipeline(stage_fn, stage_blocks, h_mbs,
-                            num_model_chunks=self.chunks,
-                            remat_stage=self.remat_stage)
-
         def head_one(h_mb, labels_mb):
             h_f = self._ln_final(params, h_mb)
             logits = self.logits_local(params, h_f)  # (S, mb, V/tp)
@@ -394,8 +390,14 @@ class GPTPipelined(GPT):
                 logits, labels_mb, axis_name=c.axis_name))
 
         lbl = labels.reshape(m, mb, S).transpose(0, 2, 1)  # (m, S, mb)
-        losses = jax.vmap(head_one)(out, lbl)
-        return jnp.mean(losses)
+        # head + loss run on the LAST STAGE inside the clocked scan and
+        # only a scalar crosses the pp axis (the old path psum'd the
+        # whole (m, S, mb, H) stacked output every step)
+        total = spmd_pipeline(stage_fn, stage_blocks, h_mbs,
+                              num_model_chunks=self.chunks,
+                              remat_stage=self.remat_stage,
+                              loss_fn=head_one, loss_args=lbl)
+        return total / m
 
 
 def gpt_350m(**overrides) -> GPT:

@@ -38,7 +38,8 @@ from apex_tpu.parallel.mesh import PP_AXIS
 
 def spmd_pipeline(stage_fn: Callable, stage_params, microbatches, *,
                   axis_name: str = PP_AXIS, num_model_chunks: int = 1,
-                  remat_stage: bool = False):
+                  remat_stage: bool = False,
+                  loss_fn: Optional[Callable] = None, loss_args=None):
     """Run `microbatches` through pp × num_model_chunks sequential stages.
 
     stage_fn(chunk_params, x, chunk_index) -> y — the layers owned by one
@@ -47,8 +48,17 @@ def spmd_pipeline(stage_fn: Callable, stage_params, microbatches, *,
     (leading dim num_model_chunks; pass chunk dim even when 1).
     microbatches: (m, ...) stacked microbatch inputs (the stage-0 feed).
 
-    Returns (m, ...) outputs "as if" x was passed through all stages in
-    order.  Call inside shard_map; this device holds its pp shard of
+    Without loss_fn, returns (m, ...) outputs "as if" x was passed
+    through all stages in order — replicating the full stacked output
+    costs O(m × activation) pp-axis traffic, so prefer loss_fn when the
+    caller only needs the loss.  With loss_fn(y, loss_args[k]) -> scalar
+    it is evaluated ON THE LAST STAGE inside the clocked scan as each
+    microbatch completes (so the head/loss work overlaps later clocks)
+    and only the SCALAR loss sum crosses the pp axis (≡ the reference,
+    which computes loss on the last stage only — schedules/common.py:
+    253-322 — and never ships activations backwards).
+
+    Call inside shard_map; this device holds its pp shard of
     stage_params.  Differentiable: AD yields the reverse pipeline.
     """
     pp = lax.axis_size(axis_name)
@@ -66,9 +76,34 @@ def spmd_pipeline(stage_fn: Callable, stage_params, microbatches, *,
     mb_shape = microbatches.shape[1:]
     dtype = microbatches.dtype
 
+    def finish(acc):
+        return _broadcast_from_last(acc, stage, pp, axis_name)
+
+    if loss_fn is None:
+        acc0 = jnp.zeros((m,) + mb_shape, dtype)
+
+        def collect(acc, y, k, write):
+            return lax.cond(
+                write,
+                lambda o: lax.dynamic_update_index_in_dim(
+                    o, y, jnp.clip(k, 0, m - 1), axis=0),
+                lambda o: o, acc)
+    else:
+        acc0 = jnp.zeros((), jnp.float32)
+
+        def collect(acc, y, k, write):
+            kk = jnp.clip(k, 0, m - 1)
+            args_k = jax.tree_util.tree_map(
+                lambda a: lax.dynamic_index_in_dim(a, kk, axis=0,
+                                                   keepdims=False),
+                loss_args)
+            return acc + lax.cond(
+                write, lambda: loss_fn(y, args_k).astype(jnp.float32),
+                lambda: jnp.zeros((), jnp.float32))
+
     if num_model_chunks == 1:
         def clock1(carry, t):
-            x_in, out = carry
+            x_in, acc = carry
             feed = lax.dynamic_index_in_dim(
                 microbatches, jnp.clip(t, 0, m - 1), axis=0, keepdims=False)
             x = jnp.where(stage == 0, feed, x_in)
@@ -77,23 +112,18 @@ def spmd_pipeline(stage_fn: Callable, stage_params, microbatches, *,
             k = t - (pp - 1)  # microbatch index completing at last stage
             write = jnp.logical_and(stage == pp - 1,
                                     jnp.logical_and(k >= 0, k < m))
-            out = lax.cond(
-                write,
-                lambda o: lax.dynamic_update_index_in_dim(
-                    o, y, jnp.clip(k, 0, m - 1), axis=0),
-                lambda o: o, out)
+            acc = collect(acc, y, k, write)
             x_next = _ring_shift(y, axis_name, +1)
-            return (x_next, out), None
+            return (x_next, acc), None
 
         x0 = jnp.zeros(mb_shape, dtype)
-        out0 = jnp.zeros((m,) + mb_shape, dtype)
-        (xf, out), _ = lax.scan(clock1, (x0, out0), jnp.arange(clocks))
-        return _broadcast_from_last(out, stage, pp, axis_name)
+        (xf, acc), _ = lax.scan(clock1, (x0, acc0), jnp.arange(clocks))
+        return finish(acc)
 
     # interleaved: iterate chunks sequentially per clock with a ring
     # shift after each chunk (chunk boundary stage pp-1 → stage 0)
     def clockN(carry, t):
-        xs, out = carry  # xs: (chunks,) stacked stage inputs
+        xs, acc = carry  # xs: (chunks,) stacked stage inputs
         new_xs = []
         for c in range(num_model_chunks):
             x = xs[c]
@@ -111,11 +141,7 @@ def spmd_pipeline(stage_fn: Callable, stage_params, microbatches, *,
                 kk = t - (pp * num_model_chunks - 1)
                 write = jnp.logical_and(stage == pp - 1,
                                         jnp.logical_and(kk >= 0, kk < m))
-                out = lax.cond(
-                    write,
-                    lambda o: lax.dynamic_update_index_in_dim(
-                        o, y, jnp.clip(kk, 0, m - 1), axis=0),
-                    lambda o: o, out)
+                acc = collect(acc, y, kk, write)
             shifted = _ring_shift(y, axis_name, +1)
             new_xs.append(shifted)
         # routing for next clock: stage s>0 chunk c reads chunk c's shift
@@ -124,13 +150,12 @@ def spmd_pipeline(stage_fn: Callable, stage_params, microbatches, *,
         nxt = [new_xs[0]]
         for c in range(1, num_model_chunks):
             nxt.append(jnp.where(stage == 0, new_xs[c - 1], new_xs[c]))
-        return (jnp.stack(nxt), out), None
+        return (jnp.stack(nxt), acc), None
 
     xs0 = jnp.zeros((num_model_chunks,) + mb_shape, dtype)
-    out0 = jnp.zeros((m,) + mb_shape, dtype)
-    (xsf, out), _ = lax.scan(clockN, (xs0, out0),
+    (xsf, acc), _ = lax.scan(clockN, (xs0, acc0),
                              jnp.arange(m + total_stages - 1))
-    return _broadcast_from_last(out, stage, pp, axis_name)
+    return finish(acc)
 
 
 def _broadcast_from_last(out, stage, pp, axis_name):
@@ -178,12 +203,14 @@ def forward_backward_pipelining_without_interleaving(
     fwd_bwd_pipelining_without_interleaving.py:241-597.
 
     Returns mean loss over microbatches; differentiate the whole thing
-    for the backward pipeline.  loss_fn(y_microbatch) -> scalar.
+    for the backward pipeline.  loss_fn(y_microbatch) -> scalar,
+    evaluated on the last stage inside the scan (scalar pp traffic
+    only).
     """
-    out = spmd_pipeline(stage_fn, stage_params, microbatches,
-                        axis_name=axis_name, remat_stage=remat_stage)
-    losses = jax.vmap(loss_fn)(out)
-    return jnp.mean(losses)
+    total = spmd_pipeline(stage_fn, stage_params, microbatches,
+                          axis_name=axis_name, remat_stage=remat_stage,
+                          loss_fn=lambda y, _: loss_fn(y), loss_args=None)
+    return total / microbatches.shape[0]
 
 
 def forward_backward_pipelining_with_interleaving(
@@ -192,12 +219,12 @@ def forward_backward_pipelining_with_interleaving(
         remat_stage: bool = False):
     """Interleaved/virtual-pp schedule ≡
     fwd_bwd_pipelining_with_interleaving.py:27-744."""
-    out = spmd_pipeline(stage_fn, stage_params, microbatches,
-                        axis_name=axis_name,
-                        num_model_chunks=num_model_chunks,
-                        remat_stage=remat_stage)
-    losses = jax.vmap(loss_fn)(out)
-    return jnp.mean(losses)
+    total = spmd_pipeline(stage_fn, stage_params, microbatches,
+                          axis_name=axis_name,
+                          num_model_chunks=num_model_chunks,
+                          remat_stage=remat_stage,
+                          loss_fn=lambda y, _: loss_fn(y), loss_args=None)
+    return total / microbatches.shape[0]
 
 
 def get_forward_backward_func(virtual_pipeline_model_parallel_size,

@@ -19,7 +19,7 @@ from jax import shard_map
 from jax.sharding import PartitionSpec as P
 
 from apex_tpu.optimizers import flat as F
-from apex_tpu.parallel.mesh import DP_AXIS
+from apex_tpu.parallel.mesh import DP_AXIS, PP_AXIS
 
 
 def init_sharded_optimizer(optimizer, model, params, mesh):
@@ -47,13 +47,24 @@ def init_sharded_optimizer(optimizer, model, params, mesh):
 
 def make_tp_dp_train_step(model, optimizer, mesh, *,
                           loss_fn: Optional[Callable] = None,
-                          donate: bool = True):
+                          donate: bool = True,
+                          pp_partial_grads: Optional[bool] = None):
     """Returns step(opt_state, tokens, labels[, key]) ->
     (opt_state, loss).  `loss_fn(params, tokens, labels)` defaults to
     model.loss.  Batch is sharded over dp; params/optimizer over tp.
+
+    pp_partial_grads: whether pp-replicated leaves carry PARTIAL grads
+    that must be psum'd over pp (True for pipelined models, whose
+    embedding/head grads land on different stages — ≡ the reference's
+    embedding-group allreduce).  A non-pipelined model on a pp>1 mesh
+    computes COMPLETE identical grads on every stage, where the psum
+    would scale them by pp.  Default: infer from the model's
+    `pipeline_parallel_size`/`pp` attribute.
     """
     specs = model.partition_specs()
     lf = loss_fn or (lambda p, t, l: model.loss(p, t, l))
+    if pp_partial_grads is None:
+        pp_partial_grads = getattr(model, "pp", 1) > 1
 
     def local_step(opt_state, tokens, labels):
         # NOTE: differentiating w.r.t. the flat param view (so grads
@@ -66,6 +77,22 @@ def make_tp_dp_train_step(model, optimizer, mesh, *,
             params)
         grads = jax.tree_util.tree_map(
             lambda g: jax.lax.pmean(g, DP_AXIS), grads)
+        if pp_partial_grads:
+            # pp-REPLICATED leaves (tied embedding, position embeddings,
+            # final LN) get per-stage PARTIAL grads under the pipeline —
+            # embed-side on stage 0, head-side on the last stage — so
+            # each stage's optimizer copy would diverge without summing
+            # them.  ≡ the reference's embedding-group allreduce
+            # (parallel_state.py:319-407).
+            def _pp_sync(g, spec):
+                names = set()
+                for entry in spec:  # P is tuple-like: None | str | tuple
+                    (names.update(entry) if isinstance(entry, tuple)
+                     else names.add(entry))
+                if PP_AXIS in names:
+                    return g  # pp-sharded leaf: its grad is stage-local
+                return jax.lax.psum(g, PP_AXIS)
+            grads = jax.tree_util.tree_map(_pp_sync, grads, specs)
         _, new_state = optimizer.step(opt_state, grads)
         return new_state, jax.lax.pmean(loss, DP_AXIS)
 
