@@ -64,7 +64,9 @@ def make_tp_dp_train_step(model, optimizer, mesh, *,
     specs = model.partition_specs()
     lf = loss_fn or (lambda p, t, l: model.loss(p, t, l))
     if pp_partial_grads is None:
-        pp_partial_grads = getattr(model, "pp", 1) > 1
+        pp_partial_grads = max(
+            getattr(model, "pp", 1),
+            getattr(model, "pipeline_parallel_size", 1)) > 1
 
     def local_step(opt_state, tokens, labels):
         # NOTE: differentiating w.r.t. the flat param view (so grads
