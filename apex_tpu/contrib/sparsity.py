@@ -224,7 +224,11 @@ def search_channel_permutation(weight, window: int = 8,
         i = rng.randint(0, c // 2)
         j = c // 2 + rng.randint(0, c - c // 2)
         cand[i], cand[j] = cand[j], cand[i]
-        cand = _greedy_rounds(w_np, cand)
+        # wide matrices keep the bounded-round budget here too — an
+        # unbounded full-width re-convergence would dwarf the
+        # subdivided main search
+        cand = _greedy_rounds(w_np, cand,
+                              max_rounds=4 if c > max_cols else 32)
         s = float(magnitude_after_mask(jnp.asarray(w_np)[:, cand]))
         if s > best + 1e-6:
             perm, best = cand, s

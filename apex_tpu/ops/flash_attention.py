@@ -133,26 +133,41 @@ def _extras_specs(h, nq, bq, nk, bk, has_bias, nb, nh, has_seg, *,
     return bspec, qspec, kspec
 
 
+def _fmix32(h):
+    """murmur3 finalizer: full avalanche over int32 lanes."""
+    h = h ^ lax.shift_right_logical(h, 16)
+    h = h * jnp.int32(-2048144789)        # 0x85ebca6b
+    h = h ^ lax.shift_right_logical(h, 13)
+    h = h * jnp.int32(-1028477387)        # 0xc2b2ae35
+    h = h ^ lax.shift_right_logical(h, 16)
+    return h
+
+
 def _dropout_keep(seed_ref, i, j, t, shape, rate):
-    """Deterministic per-score-block keep mask.
+    """Deterministic per-score-block keep mask from a COORDINATE hash.
 
     ≡ the reference FMHA's philox dropout (apex/contrib/csrc/fmha/src/
-    fmha/softmax.h): counter-based bits seeded by (seed, block coords)
-    so the BACKWARD kernels regenerate the identical mask from the same
-    seed without storing sq x sk bytes.  Works in both grid orders
-    because (i, j, t) are the logical (batch*head, q-block, k-block)
-    ids, not the grid axes."""
-    # single-scalar seeding (multi-arg prng_seed doesn't lower on all
-    # libtpu versions): mix (seed, block coords) with a Knuth-style LCG
-    h = seed_ref[0, 0]
-    for c in (i, j, t):
-        h = h * jnp.int32(1000003) + jnp.int32(c)
-    pltpu.prng_seed(h)
-    bits = pltpu.prng_random_bits(shape)
+    fmha/softmax.h): counter-based bits so the BACKWARD kernels
+    regenerate the identical mask without storing sq x sk bytes.  The
+    bits are a murmur-style hash of (seed, head, GLOBAL score
+    coordinates) — a pure function of the element's identity, so any
+    kernel (any grid order, any block size, interpret mode included)
+    reproduces it exactly.  The hardware PRNG
+    (pltpu.prng_random_bits) is NOT usable here: its stream→element
+    mapping follows each kernel's codegen, so forward and backward
+    kernels with different structure silently disagree (caught by the
+    examples/tpu_kernel_smoke.py dropout gate)."""
+    bk, bq = shape
+    krow = t * bk + lax.broadcasted_iota(jnp.int32, shape, 0)  # k global
+    qcol = j * bq + lax.broadcasted_iota(jnp.int32, shape, 1)  # q global
+    h = seed_ref[0, 0] * jnp.int32(1000003) + jnp.int32(i)
+    v = (h + krow * jnp.int32(-1640531535)       # 0x9e3779b1
+         + qcol * jnp.int32(-2048144777))        # 0x85ebca77
+    v = _fmix32(v)
     # integer-only compare (Mosaic has no uint32->f32 cast): clear the
     # sign bit for a uniform int32 in [0, 2^31) and threshold against
     # rate * 2^31
-    r = bits.astype(jnp.int32) & jnp.int32(0x7FFFFFFF)
+    r = v & jnp.int32(0x7FFFFFFF)
     thresh = jnp.int32(int(rate * 2147483648.0))
     return r >= thresh
 
@@ -703,17 +718,10 @@ def flash_attention(q, k, v, *, causal: bool = False,
             raise ValueError(
                 f"segment id shapes {q_segment_ids.shape}/"
                 f"{kv_segment_ids.shape} != ({b}, {sq})/({b}, {sk})")
-    # the in-kernel dropout path needs the TPU hardware PRNG
-    # (pltpu.prng_seed has no interpret-mode lowering)
-    if (dropout_rate > 0.0 and use_pallas_override is True
-            and pallas_interpret()):
-        raise NotImplementedError(
-            "in-kernel dropout needs the TPU hardware PRNG; interpret "
-            "mode has no lowering for it (and its mask stream differs "
-            "from the dense fallback's, so goldens would not transfer)")
+    # in-kernel dropout is a pure coordinate hash — it runs (and gives
+    # bit-identical masks) in interpret mode too, so CPU CI covers it
     kernel_ok = (use_pallas(use_pallas_override)
-                 and _pick_block(q.shape[2]) and _pick_block(k.shape[2])
-                 and (dropout_rate == 0.0 or not pallas_interpret()))
+                 and _pick_block(q.shape[2]) and _pick_block(k.shape[2]))
     if kernel_ok:
         if dropout_rate > 0.0:
             seed = jax.random.randint(dropout_key, (1, 1), -2**31, 2**31 - 1,

@@ -40,12 +40,11 @@ class DistributedFusedAdamState(NamedTuple):
     exp_avg_sq: jnp.ndarray
 
 
-class _ShardedFlat:
+class _ShardedFlat(F.FlatCheckpointMixin):
     """Shared flat-buffer plumbing for the ZeRO optimizers: ONE place
     defines the (dtype, align, pad_to) layout so init and step can never
-    drift apart, plus the checkpoint layout guard (see flat.check_layout
-    — total lengths can coincide after FLAT_TILE rounding, so a shape
-    check alone cannot catch offset-moving layout changes)."""
+    drift apart.  Checkpoint plumbing (layout fingerprint + loud
+    restore-before-init guard) comes from FlatCheckpointMixin."""
 
     _ALIGN = 1  # subclasses override when they need lane-aligned leaves
 
@@ -56,17 +55,18 @@ class _ShardedFlat:
         return F.flatten(tree, jnp.float32, align=self._ALIGN,
                          pad_to=self.num_shards * K.FLAT_TILE)
 
-    def state_dict(self, state) -> dict:
-        d = dict(state._asdict())
-        d["flat_layout"] = F.layout_dict(self.spec)
-        return d
+    def _gather_full(self, shard):
+        """All-gather a flat shard into the full (trimmed) pytree —
+        the single definition of the gather/trim/unflatten sequence
+        used by full_params and both steps."""
+        full = lax.all_gather(shard, self.axis_name, axis=0, tiled=True)
+        return F.unflatten(full[: self.spec.total], self.spec)
 
-    def load_state_dict(self, d: dict):
-        if self.spec is not None:
-            F.check_layout(self.spec, d, type(self).__name__)
-        cls = type(self)._STATE
-        return cls(**{k: jnp.asarray(v) for k, v in d.items()
-                      if k != "flat_layout"})
+    def full_params(self, state):
+        """All-gather this rank's shard into the full params pytree
+        (≡ the reference's bucketed param all-gather, the fwd-side half
+        of ZeRO-2).  Shard-local: call inside shard_map."""
+        return self._gather_full(state.params_shard)
 
 
 class DistributedFusedAdam(_ShardedFlat):
@@ -126,9 +126,7 @@ class DistributedFusedAdam(_ShardedFlat):
         new_state = DistributedFusedAdamState(
             step=step_next, params_shard=p, exp_avg=m, exp_avg_sq=v)
         # param all-gather ≡ the bucketed all-gather param sync
-        full = lax.all_gather(p, ax, axis=0, tiled=True)
-        full = full[: self.spec.total]
-        return F.unflatten(full, self.spec), new_state
+        return self._gather_full(p), new_state
 
 
 class DistributedFusedLAMBState(NamedTuple):
@@ -222,5 +220,4 @@ class DistributedFusedLAMB(_ShardedFlat):
         v = jnp.where(found, state.exp_avg_sq, v)
         new_state = DistributedFusedLAMBState(
             step=step_next, params_shard=p, exp_avg=m, exp_avg_sq=v)
-        full = lax.all_gather(p, ax, axis=0, tiled=True)[: self.spec.total]
-        return F.unflatten(full, self.spec), new_state
+        return self._gather_full(p), new_state

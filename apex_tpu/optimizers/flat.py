@@ -118,9 +118,52 @@ def check_layout(spec: FlatSpec, d: dict, who: str) -> None:
                 f"{who}: checkpoint has no flat_layout record but the "
                 f"current spec is align={spec.align}; offsets would not "
                 "match — re-save the checkpoint with this version")
+        # a full (unsharded) buffer must still cover spec.total —
+        # catches pre-layout checkpoints whose padding rule changed
+        # (shard buffers can't be validated without the shard count;
+        # their loaders require a recorded layout instead)
+        arr = d.get("params")
+        if arr is not None and hasattr(arr, "shape") and len(
+                getattr(arr, "shape", ())) == 1:
+            if int(arr.shape[0]) < spec.total:
+                raise ValueError(
+                    f"{who}: pre-layout checkpoint buffer has "
+                    f"{int(arr.shape[0])} elements < spec total "
+                    f"{spec.total} — wrong layout or truncated")
         return
     want = layout_dict(spec)
     if {k: int(lay[k]) for k in want} != want:
         raise ValueError(
             f"{who}: checkpoint flat layout {lay} does not match the "
             f"current spec {want}")
+
+
+class FlatCheckpointMixin:
+    """Shared checkpoint plumbing for flat-buffer optimizers.
+
+    State is a NamedTuple of arrays (``step`` plus flat buffers);
+    subclasses set ``_STATE``.  ``state_dict`` embeds the layout
+    fingerprint; ``load_state_dict`` refuses to restore before init()
+    (without a spec the layout cannot be validated and a mismatched
+    checkpoint would fail later with an opaque shape error)."""
+
+    _STATE = None
+
+    def state_dict(self, state) -> dict:
+        d = dict(state._asdict())
+        d["flat_layout"] = layout_dict(self.spec)
+        return d
+
+    def load_state_dict(self, d: dict):
+        if self.spec is None:
+            raise ValueError(
+                f"{type(self).__name__}.load_state_dict called before "
+                "init(); call init(params) first so the checkpoint's "
+                "flat layout can be validated")
+        check_layout(self.spec, d, type(self).__name__)
+        cls = type(self)._STATE
+        fields = {k: jnp.asarray(v) for k, v in d.items()
+                  if k != "flat_layout"}
+        if "step" in fields:
+            fields["step"] = jnp.asarray(d["step"], jnp.int32)
+        return cls(**fields)

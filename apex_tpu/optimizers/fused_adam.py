@@ -31,10 +31,12 @@ class FusedAdamState(NamedTuple):
     exp_avg_sq: jnp.ndarray  # flat fp32 v
 
 
-class FusedAdam:
+class FusedAdam(F.FlatCheckpointMixin):
     """API shape: opt = FusedAdam(lr=...); state = opt.init(params);
     params, state = opt.step(state, grads[, lr=, inv_scale=, found_inf=]).
     """
+
+    _STATE = FusedAdamState
 
     def __init__(self, lr=1e-3, bias_correction=True, betas=(0.9, 0.999),
                  eps=1e-8, adam_w_mode=True, weight_decay=0.0,
@@ -99,16 +101,4 @@ class FusedAdam:
                                    exp_avg_sq=v)
         return F.unflatten(p, self.spec), new_state
 
-    # --- checkpoint parity ≡ torch optimizer state_dict -------------------
-    def state_dict(self, state: FusedAdamState) -> dict:
-        return {"step": state.step, "params": state.params,
-                "exp_avg": state.exp_avg, "exp_avg_sq": state.exp_avg_sq,
-                "flat_layout": F.layout_dict(self.spec)}
-
-    def load_state_dict(self, d: dict) -> FusedAdamState:
-        if self.spec is not None:
-            F.check_layout(self.spec, d, "FusedAdam")
-        return FusedAdamState(step=jnp.asarray(d["step"], jnp.int32),
-                              params=jnp.asarray(d["params"]),
-                              exp_avg=jnp.asarray(d["exp_avg"]),
-                              exp_avg_sq=jnp.asarray(d["exp_avg_sq"]))
+    # checkpoint parity ≡ torch optimizer state_dict: FlatCheckpointMixin

@@ -27,7 +27,9 @@ class FusedLAMBState(NamedTuple):
     exp_avg_sq: jnp.ndarray
 
 
-class FusedLAMB:
+class FusedLAMB(F.FlatCheckpointMixin):
+    _STATE = FusedLAMBState
+
     def __init__(self, lr=1e-3, bias_correction=True, betas=(0.9, 0.999),
                  eps=1e-6, weight_decay=0.01, amsgrad=False,
                  adam_w_mode=True, grad_averaging=True,
@@ -100,19 +102,7 @@ class FusedLAMB:
                                    exp_avg_sq=v)
         return F.unflatten(p, self.spec), new_state
 
-    # --- checkpoint parity -------------------------------------------------
-    def state_dict(self, state: FusedLAMBState) -> dict:
-        return {"step": state.step, "params": state.params,
-                "exp_avg": state.exp_avg, "exp_avg_sq": state.exp_avg_sq,
-                "flat_layout": F.layout_dict(self.spec)}
-
-    def load_state_dict(self, d: dict) -> FusedLAMBState:
-        if self.spec is not None:
-            F.check_layout(self.spec, d, "FusedLAMB")
-        return FusedLAMBState(step=jnp.asarray(d["step"], jnp.int32),
-                        params=jnp.asarray(d["params"]),
-                        exp_avg=jnp.asarray(d["exp_avg"]),
-                        exp_avg_sq=jnp.asarray(d["exp_avg_sq"]))
+    # checkpoint parity: FlatCheckpointMixin
 
 
 class FusedMixedPrecisionLamb(FusedLAMB):

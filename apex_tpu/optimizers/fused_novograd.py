@@ -22,7 +22,9 @@ class FusedNovoGradState(NamedTuple):
     exp_avg_sq: jnp.ndarray     # (num_tensors,) per-tensor v
 
 
-class FusedNovoGrad:
+class FusedNovoGrad(F.FlatCheckpointMixin):
+    _STATE = FusedNovoGradState
+
     def __init__(self, lr=1e-3, bias_correction=True, betas=(0.95, 0.98),
                  eps=1e-8, weight_decay=0.0, grad_averaging=False,
                  amsgrad=False, reg_inside_moment=False,
@@ -99,16 +101,4 @@ class FusedNovoGrad:
                                        exp_avg_sq=v)
         return F.unflatten(p, self.spec), new_state
 
-    # --- checkpoint parity -------------------------------------------------
-    def state_dict(self, state: FusedNovoGradState) -> dict:
-        return {"step": state.step, "params": state.params,
-                "exp_avg": state.exp_avg, "exp_avg_sq": state.exp_avg_sq,
-                "flat_layout": F.layout_dict(self.spec)}
-
-    def load_state_dict(self, d: dict) -> FusedNovoGradState:
-        if self.spec is not None:
-            F.check_layout(self.spec, d, "FusedNovoGrad")
-        return FusedNovoGradState(step=jnp.asarray(d["step"], jnp.int32),
-                        params=jnp.asarray(d["params"]),
-                        exp_avg=jnp.asarray(d["exp_avg"]),
-                        exp_avg_sq=jnp.asarray(d["exp_avg_sq"]))
+    # checkpoint parity: FlatCheckpointMixin

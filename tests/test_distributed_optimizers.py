@@ -131,3 +131,107 @@ def test_zero_optimizer_layout_guard():
     with pytest.raises(ValueError, match="flat_layout"):
         opt.load_state_dict(bad)
     M.destroy_model_parallel()
+
+
+# --------------------- scale / invariance (round 2, VERDICT #7) -------------
+
+def _big_params(total_m=100):
+    """~total_m million params in a few transformer-shaped leaves."""
+    n = int(total_m * 1e6)
+    side = 4096
+    big = n // (2 * side)
+    k = jax.random.PRNGKey(0)
+    ks = jax.random.split(k, 3)
+    return {
+        "wq": jax.random.normal(ks[0], (side, big)) * 0.02,
+        "wk": jax.random.normal(ks[1], (big, side)) * 0.02,
+        "ln": jax.random.normal(ks[2], (side,)),
+    }
+
+
+def _zero_steps(opt_cls, params, grads, num_shards, steps=2, **kw):
+    mesh = M.initialize_model_parallel(
+        devices=jax.devices()[:num_shards])
+    opt = opt_cls(num_shards=num_shards, lr=1e-2, use_pallas=False, **kw)
+    sspec = opt._STATE(P(), P("dp"), P("dp"), P("dp"))
+    state = jax.jit(shard_map(opt.init, mesh=mesh, in_specs=(P(),),
+                              out_specs=sspec, check_vma=False))(params)
+
+    def local_step(state, g):
+        return opt.step(state, g)
+
+    step = jax.jit(shard_map(local_step, mesh=mesh, in_specs=(sspec, P()),
+                             out_specs=(P(), sspec), check_vma=False))
+    full = None
+    for _ in range(steps):
+        full, state = step(state, grads)
+    M.destroy_model_parallel()
+    return full, state, opt
+
+
+def test_dist_adam_100m_scale_and_state_roundtrip():
+    """dp=8 DistributedFusedAdam at 100M params on the virtual mesh:
+    per-rank state is 1/8 of the padded total, updates match unsharded
+    FusedAdam, and the sharded state_dict round-trips (≡ the reference's
+    test_dist_adam.py scale + state gather/scatter paths)."""
+    M.destroy_model_parallel()
+    params = _big_params(100)
+    grads = jax.tree_util.tree_map(lambda p: p * 0.01, params)
+    full, state, opt = _zero_steps(DistributedFusedAdam, params, grads, DP)
+
+    total = sum(int(np.prod(l.shape))
+                for l in jax.tree_util.tree_leaves(params))
+    assert total >= 100_000_000
+    # the state really is dp-sharded: each device holds exactly 1/DP of
+    # the padded buffer (inspect the device-local shards, not the
+    # logically-gathered global view)
+    padded = state.params_shard.shape[0]
+    assert padded >= total and padded % DP == 0
+    for buf in (state.params_shard, state.exp_avg, state.exp_avg_sq):
+        shards = buf.addressable_shards
+        assert len(shards) == DP
+        assert all(sh.data.shape[0] == padded // DP for sh in shards)
+
+    ref = FusedAdam(lr=1e-2, use_pallas=False)
+    rstate = ref.init(params)
+    rp = params
+    for _ in range(2):
+        rp, rstate = ref.step(rstate, grads)
+    np.testing.assert_allclose(np.asarray(full["wq"][:2, :64]),
+                               np.asarray(rp["wq"][:2, :64]),
+                               rtol=1e-5, atol=1e-6)
+
+    # state_dict round trip at scale: resumed state continues identically
+    d = opt.state_dict(state)
+    restored = opt.load_state_dict(
+        {k: np.asarray(v) if hasattr(v, "shape") else v
+         for k, v in d.items()})
+    for a, b in zip(state, restored):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_dist_adam_shard_count_invariance():
+    """The same optimization trajectory regardless of dp shard count
+    (4 vs 8 ranks) — resulting full params must agree."""
+    M.destroy_model_parallel()
+    params = _params(jax.random.PRNGKey(2))
+    grads = jax.tree_util.tree_map(lambda p: p * 0.1, params)
+    full8, _, _ = _zero_steps(DistributedFusedAdam, params, grads, 8,
+                              steps=3)
+    full4, _, _ = _zero_steps(DistributedFusedAdam, params, grads, 4,
+                              steps=3)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-7),
+        full8, full4)
+
+
+def test_dist_lamb_100m_scale():
+    M.destroy_model_parallel()
+    params = _big_params(100)
+    grads = jax.tree_util.tree_map(lambda p: p * 0.01, params)
+    full, state, opt = _zero_steps(DistributedFusedLAMB, params, grads, DP,
+                                   steps=1)
+    leaves = jax.tree_util.tree_leaves(full)
+    assert all(np.isfinite(np.asarray(l)).all() for l in leaves)
+    assert state.params_shard.shape[0] % DP == 0

@@ -238,3 +238,37 @@ def test_flash_segment_api_validation():
         flash_attention(q, k, v, q_segment_ids=seg)
     with pytest.raises(ValueError):
         flash_attention(q, k, v, bias=jnp.zeros((3, 1, 32, 32)))
+
+
+def test_flash_in_kernel_dropout_mask_consistency():
+    """The in-kernel dropout mask is a pure coordinate hash, so
+    interpret mode reproduces the TPU masks bit-for-bit and fwd/bwd
+    must agree: with a fixed mask the output is LINEAR in v, making
+    directional finite differences exact (this was unverifiable in CPU
+    CI with the hardware PRNG — whose stream order even differed
+    between the fwd and fused-bwd kernels)."""
+    from apex_tpu.ops.flash_attention import _flash
+    B, H, S, D = 1, 2, 128, 32
+    qq = jax.random.normal(jax.random.PRNGKey(0), (B, H, S, D))
+    kk = jax.random.normal(jax.random.PRNGKey(1), (B, H, S, D))
+    vv = jax.random.normal(jax.random.PRNGKey(2), (B, H, S, D))
+    cc = jax.random.normal(jax.random.PRNGKey(3), (B, H, S, D))
+    seed = jnp.asarray([[777]], jnp.int32)
+    args = (None, None, None, 0.18, True, 0.2, None, None, seed)
+    o1 = np.asarray(_flash(qq, kk, vv, *args))
+    o2 = np.asarray(_flash(qq, kk, vv, *args))
+    np.testing.assert_array_equal(o1, o2)
+
+    def f(v_):
+        return jnp.vdot(_flash(qq, kk, v_, *args), cc)
+
+    gv = jax.grad(f)(vv)
+    dirv = jax.random.normal(jax.random.PRNGKey(4), vv.shape)
+    fd = float(f(vv + 0.5 * dirv)) - float(f(vv - 0.5 * dirv))
+    an = float(jnp.vdot(gv, dirv))
+    assert abs(fd - an) < 1e-3 * abs(an) + 1e-4, (fd, an)
+
+    # keep-rate statistic ~ 1 - rate
+    p_nodrop = np.asarray(_flash(
+        qq, kk, vv, None, None, None, 0.18, True, 0.0, None, None, seed))
+    assert not np.allclose(o1, p_nodrop)
