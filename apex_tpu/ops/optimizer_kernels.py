@@ -512,3 +512,54 @@ def expand_per_tensor_aligned(values, spec, total):
         elem = jnp.concatenate(
             [elem, jnp.broadcast_to(values[-1], (total - elem.shape[0],))])
     return elem
+
+
+def _row_segment_ids_padded(spec, rows_total):
+    """Row→tensor map over the PADDED buffer (rows_total >= spec rows):
+    tail padding rows get the dummy id len(spec.sizes)."""
+    import numpy as _np
+    base = _row_segment_ids(spec)
+    pad = rows_total - base.shape[0]
+    return _np.concatenate(
+        [base, _np.full((pad,), len(spec.sizes), _np.int32)])
+
+
+def shard_segment_ids(spec, rank, rows_shard, padded_total):
+    """This rank's slice of the padded row→tensor map (tail padding rows
+    get the dummy id len(spec.sizes)).  The shard is a contiguous flat
+    slice [rank*S, (rank+1)*S) with S a multiple of FLAT_TILE, so its
+    rows are a contiguous run of the global row map — a dynamic slice at
+    a traced `rank` is all it takes.  Compute ONCE per step and pass to
+    the per-tensor helpers below (the full row map is O(params/128))."""
+    assert spec.align % _LANES == 0
+    seg_full = jnp.asarray(
+        _row_segment_ids_padded(spec, padded_total // _LANES))
+    return jax.lax.dynamic_slice(seg_full, (rank * rows_shard,),
+                                 (rows_shard,))
+
+
+def per_tensor_sumsq_shard(shard, spec, seg):
+    """Per-tensor PARTIAL sums of squares over ONE rank's contiguous
+    flat shard (`seg` from shard_segment_ids).  A psum over the shard
+    axis yields the exact full-buffer per-tensor sums — no rank ever
+    materializes the full buffer (≡ the reference's pipelined
+    block-reduction L2 norms, distributed_fused_lamb.py:728-987, which
+    exist for the same reason).  Returns (n_tensors,) fp32 partial sums;
+    the dummy tail segment (zero padding) is dropped."""
+    x2 = shard.reshape(-1, _LANES).astype(jnp.float32)
+    rowsq = jnp.sum(x2 * x2, axis=1)                      # (rows,)
+    sums = jax.ops.segment_sum(rowsq, seg,
+                               num_segments=len(spec.sizes) + 1)
+    return sums[: len(spec.sizes)]
+
+
+def expand_per_tensor_shard(values, seg):
+    """Broadcast per-tensor scalars to ONE rank's shard elements —
+    the shard-local counterpart of expand_per_tensor_aligned (padding
+    rows broadcast 1.0, harmless on zero-padded updates)."""
+    rows_shard = seg.shape[0]
+    vals = jnp.concatenate(
+        [values.astype(jnp.float32), jnp.ones((1,), jnp.float32)])
+    per_row = vals[seg]                                    # (rows,)
+    return jnp.broadcast_to(per_row[:, None],
+                            (rows_shard, _LANES)).reshape(-1)

@@ -198,20 +198,28 @@ class DistributedFusedLAMB(_ShardedFlat):
             bias_correction=self.bias_correction,
             use_pallas_override=self.use_pallas)
 
-        # per-tensor norms need the full u and p: gather norms cheaply by
-        # computing segment sums of squares on the gathered buffers
-        full_p = lax.all_gather(state.params_shard, ax, axis=0, tiled=True)
-        full_u = lax.all_gather(u, ax, axis=0, tiled=True)
-        wn = K.per_tensor_l2norm_aligned(full_p, self.spec)
-        un = K.per_tensor_l2norm_aligned(full_u, self.spec)
-        ratio = jnp.where((wn > 0) & (un > 0), wn / jnp.maximum(un, 1e-12),
-                          1.0)
-        ratio_elem = K.expand_per_tensor_aligned(ratio, self.spec,
-                                                 self.padded_total)
+        # per-tensor norms WITHOUT materializing the full buffers: each
+        # rank computes partial per-tensor sums of squares over its own
+        # contiguous shard (segment boundaries are static from FlatSpec;
+        # the shard start is rank*shard_size) and ONE small psum of the
+        # 2*n_tensors partials yields exact norms — ≡ the reference's
+        # pipelined block reductions (distributed_fused_lamb.py:728-987),
+        # which likewise never gather the model onto one rank.  The only
+        # full-size all-gather left in the step is the final param sync.
         shard_size = self.padded_total // self.num_shards
         rank = lax.axis_index(ax)
-        ratio_shard = lax.dynamic_slice(ratio_elem, (rank * shard_size,),
-                                        (shard_size,))
+        seg = K.shard_segment_ids(self.spec, rank, shard_size // K._LANES,
+                                  self.padded_total)
+        pn_part = K.per_tensor_sumsq_shard(state.params_shard, self.spec,
+                                           seg)
+        un_part = K.per_tensor_sumsq_shard(u, self.spec, seg)
+        sums = lax.psum(jnp.concatenate([pn_part, un_part]), ax)
+        n_t = len(self.spec.sizes)
+        wn = jnp.sqrt(sums[:n_t])
+        un = jnp.sqrt(sums[n_t:])
+        ratio = jnp.where((wn > 0) & (un > 0), wn / jnp.maximum(un, 1e-12),
+                          1.0)
+        ratio_shard = K.expand_per_tensor_shard(ratio, seg)
 
         p_new = K.lamb_phase2_flat(state.params_shard, u, ratio_shard,
                                    lr_val, use_pallas_override=self.use_pallas)

@@ -16,22 +16,36 @@ import numpy as np
 
 def larc_adjust_grads(params, grads, lr, *, trust_coefficient=0.02,
                       clip=True, eps=1e-8, weight_decay=0.0):
-    """Return LARC-adjusted grads (per-tensor adaptive scaling)."""
+    """Return LARC-adjusted grads (per-tensor adaptive scaling).
 
-    def adjust(p, g):
-        pn = jnp.linalg.norm(p.astype(jnp.float32).ravel())
-        gn = jnp.linalg.norm(g.astype(jnp.float32).ravel())
-        local_lr = trust_coefficient * pn / (gn + weight_decay * pn + eps)
-        # skip adaptation when either norm is 0 (LARC.py:92-96)
-        local_lr = jnp.where((pn > 0) & (gn > 0), local_lr, 1.0)
-        if clip:
-            scale = jnp.minimum(local_lr / lr, 1.0)
-        else:
-            scale = local_lr / lr  # eta mode: lr_total = base_lr * local_lr
+    All per-tensor norms come from ONE row-aligned segment-sum pass over
+    a lane-aligned flat view of params and grads — the same mechanism as
+    LAMB's trust-ratio pass (ops/optimizer_kernels.py
+    per_tensor_l2norm_aligned) — instead of a separate reduction per
+    leaf (dozens of tiny XLA reductions at ResNet scale)."""
+    from apex_tpu.ops import optimizer_kernels as K
+    from apex_tpu.optimizers import flat as F
+
+    spec = F.make_spec(params, align=K._LANES)
+    pn = K.per_tensor_l2norm_aligned(
+        F.flatten(params, jnp.float32, align=K._LANES), spec)
+    gn = K.per_tensor_l2norm_aligned(
+        F.flatten(grads, jnp.float32, align=K._LANES), spec)
+    local_lr = trust_coefficient * pn / (gn + weight_decay * pn + eps)
+    # skip adaptation when either norm is 0 (LARC.py:92-96)
+    local_lr = jnp.where((pn > 0) & (gn > 0), local_lr, 1.0)
+    if clip:
+        scale = jnp.minimum(local_lr / lr, 1.0)
+    else:
+        scale = local_lr / lr  # eta mode: lr_total = base_lr * local_lr
+
+    leaves_p, treedef = jax.tree_util.tree_flatten(params)
+    leaves_g = jax.tree_util.tree_leaves(grads)
+    out = []
+    for i, (p, g) in enumerate(zip(leaves_p, leaves_g)):
         g32 = g.astype(jnp.float32) + weight_decay * p.astype(jnp.float32)
-        return (g32 * scale).astype(g.dtype)
-
-    return jax.tree_util.tree_map(adjust, params, grads)
+        out.append((g32 * scale[i]).astype(g.dtype))
+    return jax.tree_util.tree_unflatten(treedef, out)
 
 
 class LARC:

@@ -111,11 +111,16 @@ def make_tp_dp_train_step(model, optimizer, mesh, *,
                       out_specs=out_specs, check_vma=False),
             donate_argnums=(0,) if donate else ())
 
+    # build() depends only on the state STRUCTURE (out_specs count its
+    # fields), so the cache is keyed on that; the jitted fn inside
+    # re-specializes per input shape/dtype on its own
     cache = {}
 
     def step(opt_state, tokens, labels):
-        if "fn" not in cache:
-            cache["fn"] = build(opt_state)
-        return cache["fn"](opt_state, tokens, labels)
+        k = jax.tree_util.tree_structure(opt_state)
+        fn = cache.get(k)
+        if fn is None:
+            fn = cache[k] = build(opt_state)
+        return fn(opt_state, tokens, labels)
 
     return step

@@ -227,11 +227,64 @@ def test_dist_adam_shard_count_invariance():
 
 
 def test_dist_lamb_100m_scale():
+    """dp=8 LAMB at 100M params: updates must MATCH unsharded FusedLAMB
+    (not just stay finite) — the shard-local per-tensor norm path has to
+    reproduce the full-buffer trust ratios exactly."""
     M.destroy_model_parallel()
     params = _big_params(100)
     grads = jax.tree_util.tree_map(lambda p: p * 0.01, params)
     full, state, opt = _zero_steps(DistributedFusedLAMB, params, grads, DP,
-                                   steps=1)
-    leaves = jax.tree_util.tree_leaves(full)
-    assert all(np.isfinite(np.asarray(l)).all() for l in leaves)
+                                   steps=1, weight_decay=0.0,
+                                   max_grad_norm=1e9)
     assert state.params_shard.shape[0] % DP == 0
+
+    ref = FusedLAMB(lr=1e-2, weight_decay=0.0, max_grad_norm=1e9,
+                    use_pallas=False)
+    rstate = ref.init(params)
+    rp, _ = ref.step(rstate, grads)
+    np.testing.assert_allclose(np.asarray(full["wq"][:2, :64]),
+                               np.asarray(rp["wq"][:2, :64]),
+                               rtol=1e-4, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(full["ln"]),
+                               np.asarray(rp["ln"]),
+                               rtol=1e-4, atol=1e-6)
+
+
+def test_dist_lamb_shard_count_invariance():
+    """Identical trajectories at dp=4 vs dp=8 — per-tensor norms must
+    not depend on how the flat buffer is sharded."""
+    M.destroy_model_parallel()
+    params = _params(jax.random.PRNGKey(5))
+    grads = jax.tree_util.tree_map(lambda p: p * 0.1, params)
+    full8, _, _ = _zero_steps(DistributedFusedLAMB, params, grads, 8,
+                              steps=3, weight_decay=0.01)
+    full4, _, _ = _zero_steps(DistributedFusedLAMB, params, grads, 4,
+                              steps=3, weight_decay=0.01)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-7),
+        full8, full4)
+
+
+def test_dist_lamb_single_full_size_allgather_hlo():
+    """HLO probe (VERDICT r2 #3): the ONLY all-gather in a
+    DistributedFusedLAMB step is the final param sync — the per-tensor
+    norm pass must not gather the params or the update buffer."""
+    import re
+    M.destroy_model_parallel()
+    mesh = M.initialize_model_parallel()
+    params = _params(jax.random.PRNGKey(6))
+    grads = jax.tree_util.tree_map(lambda p: p * 0.1, params)
+    opt = DistributedFusedLAMB(num_shards=DP, lr=1e-2, use_pallas=False)
+    sspec = DistributedFusedLAMBState(P(), P("dp"), P("dp"), P("dp"))
+    state = jax.jit(shard_map(opt.init, mesh=mesh, in_specs=(P(),),
+                              out_specs=sspec, check_vma=False))(params)
+    step = jax.jit(shard_map(lambda s, g: opt.step(s, g), mesh=mesh,
+                             in_specs=(sspec, P()),
+                             out_specs=(P(), sspec), check_vma=False))
+    txt = step.lower(state, grads).as_text()
+    # count ops, not attribute mentions (all_gather_dim)
+    n_ag = len(re.findall(r'"stablehlo\.all_gather"|stablehlo\.all_gather\(',
+                          txt))
+    assert n_ag == 1, f"expected exactly 1 all-gather (param sync), got {n_ag}"
+    M.destroy_model_parallel()

@@ -84,3 +84,38 @@ def test_gpt_trains_tp_dp():
         losses.append(float(loss))
     assert all(np.isfinite(losses))
     assert losses[-1] < losses[0] * 0.9
+
+
+def test_train_step_cache_keys_on_shapes():
+    """VERDICT r2 #8: the step builder's jit cache is keyed on input
+    shapes — a changed batch shape builds a fresh shard_map/jit instead
+    of silently reusing the first one, and two models sharing no builder
+    never cross-talk."""
+    from apex_tpu.transformer.training import (
+        init_sharded_optimizer, make_tp_dp_train_step)
+
+    mesh = M.initialize_model_parallel(tensor_model_parallel_size=4)
+    model = GPT(_cfg())
+    params = model.init(jax.random.PRNGKey(9))
+    opt = FusedAdam(lr=1e-3, use_pallas=False)
+    opt_state = init_sharded_optimizer(opt, model, params, mesh)
+    step = make_tp_dp_train_step(model, opt, mesh, donate=False)
+
+    t8, l8 = _data(batch=8)
+    t4, l4 = _data(batch=4)
+    opt_state, loss8 = step(opt_state, t8, l8)
+    opt_state, loss4 = step(opt_state, t4, l4)  # new shape, same builder
+    opt_state, loss8b = step(opt_state, t8, l8)
+    assert np.isfinite(float(loss8)) and np.isfinite(float(loss4))
+    assert np.isfinite(float(loss8b))
+
+    # a SECOND model (different width) through its own builder: both
+    # steps keep working interleaved — no shared-cache cross-talk
+    model2 = GPT(_cfg(hidden=64, num_heads=4))
+    params2 = model2.init(jax.random.PRNGKey(10))
+    opt2 = FusedAdam(lr=1e-3, use_pallas=False)
+    opt_state2 = init_sharded_optimizer(opt2, model2, params2, mesh)
+    step2 = make_tp_dp_train_step(model2, opt2, mesh, donate=False)
+    opt_state2, loss2 = step2(opt_state2, t8, l8)
+    opt_state, loss8c = step(opt_state, t8, l8)
+    assert np.isfinite(float(loss2)) and np.isfinite(float(loss8c))
