@@ -27,6 +27,7 @@ from typing import Any, Optional
 
 import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
 from jax.sharding import PartitionSpec as P
 
 from apex_tpu.ops.layer_norm import fused_layer_norm
@@ -75,6 +76,9 @@ class GPTConfig:
     # interleaving.py:351-362 + tensor_parallel/random.py:237-306):
     #   None    — save nothing, recompute the whole block (full remat)
     #   "dots"  — save matmul (MXU) outputs, recompute elementwise only
+    #   "names:a,b" — save only the listed checkpoint_name'd tensors
+    #     (qkv, attn_ctx, attn_out, ffn1, ffn_out — see _block); the
+    #     memory/recompute dial between full remat and "dots"
     remat_policy: Any = None
     axis_name: str = TP_AXIS
 
@@ -178,6 +182,7 @@ class GPT:
         """x: (S[, /tp], B, H) local.  Heads sharded over tp."""
         c = self.c
         qkv = qkv_mod.apply(block_params["qkv"], x)  # (S, B, 3H/tp)
+        qkv = checkpoint_name(qkv, "qkv")
         s, b, _ = qkv.shape
         nh_local = qkv.shape[-1] // (3 * c.head_dim)
         qkv = qkv.reshape(s, b, 3, nh_local, c.head_dim)
@@ -205,6 +210,7 @@ class GPT:
                              preferred_element_type=jnp.float32
                              ).astype(x.dtype)
         ctx = ctx.transpose(2, 0, 1, 3).reshape(s, b, -1)  # (S,B,H/tp)
+        ctx = checkpoint_name(ctx, "attn_ctx")
         return proj_mod.apply(block_params["proj"], ctx)
 
     def _block(self, i, params, x, key):
@@ -215,11 +221,14 @@ class GPT:
             k1, k2, k3 = jax.random.split(key, 3)
         h = self._ln(bp["ln1"], x)
         attn = self._attention(bp, qkv_mod, proj_mod, h, k1)
+        attn = checkpoint_name(attn, "attn_out")
         x = x + self._dropout(k2, attn)
         h = self._ln(bp["ln2"], x)
         m = fc1.apply(bp["fc1"], h)
+        m = checkpoint_name(m, "ffn1")
         m = jax.nn.gelu(m, approximate=True)
         m = fc2.apply(bp["fc2"], m)
+        m = checkpoint_name(m, "ffn_out")
         x = x + self._dropout(k3, m)
         return x
 
@@ -244,12 +253,19 @@ class GPT:
                 if c.remat_policy == "dots":
                     pol = jax.checkpoint_policies.checkpoint_dots
                     blk = jax.checkpoint(blk, policy=pol)
+                elif (isinstance(c.remat_policy, str)
+                      and c.remat_policy.startswith("names:")):
+                    names = tuple(
+                        n for n in c.remat_policy[6:].split(",") if n)
+                    pol = jax.checkpoint_policies.save_only_these_names(
+                        *names)
+                    blk = jax.checkpoint(blk, policy=pol)
                 elif c.remat_policy is None:
                     blk = jax.checkpoint(blk)
                 else:
                     raise ValueError(
                         f"unknown remat_policy {c.remat_policy!r}; "
-                        "expected None or 'dots'")
+                        "expected None, 'dots', or 'names:...'")
             h = blk(params[f"block{i}"], h)
         h = self._ln_final(params, h)
         return h

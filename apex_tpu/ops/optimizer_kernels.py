@@ -24,7 +24,9 @@ import functools
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 from apex_tpu.ops._common import pallas_interpret, use_pallas
 
@@ -80,36 +82,38 @@ def _elementwise_call(kernel, arrays, n_out, interpret_override=None):
 
 def _adam_kernel(p_ref, m_ref, v_ref, g_ref, sc_ref,
                  p_out, m_out, v_out, *,
-                 beta1, beta2, eps, weight_decay, adam_w_mode,
-                 bias_correction):
-    """sc_ref rows: [lr, inv_scale, found_inf, bc1, bc2] broadcast scalars."""
+                 eps, weight_decay, adam_w_mode):
+    """sc_ref rows: [lr_eff, inv_scale, b1e, c1, b2e, c2, rbc1, rbc2,
+    found].
+
+    The overflow-skip and bias correction are FOLDED INTO THE SCALARS on
+    the host (adam_flat): found_inf sets lr_eff=0, b*e=1, c*=0 and the
+    single g select below zeroes the (inf/nan) grad stream, so the
+    elementwise pass needs one select instead of three and the 1/bc
+    divides become rbc multiplies — the VPU (not HBM) is the bound for
+    bf16 state, so per-element op count is what this kernel optimizes."""
     g = g_ref[...].astype(jnp.float32)
     p = p_ref[...].astype(jnp.float32)
     m = m_ref[...].astype(jnp.float32)
     v = v_ref[...].astype(jnp.float32)
-    lr = sc_ref[0, 0]
+    lr_eff = sc_ref[0, 0]
     inv_scale = sc_ref[1, 0]
-    found_inf = sc_ref[2, 0]
-    bc1 = sc_ref[3, 0]
-    bc2 = sc_ref[4, 0]
-    g = g * inv_scale
+    b1e, c1 = sc_ref[2, 0], sc_ref[3, 0]
+    b2e, c2 = sc_ref[4, 0], sc_ref[5, 0]
+    rbc1, rbc2 = sc_ref[6, 0], sc_ref[7, 0]
+    # the one per-element select: inf/nan grads would otherwise poison
+    # m/v through 0*inf=nan even with c1=c2=0
+    g = jnp.where(sc_ref[8, 0] > 0.5, 0.0, g * inv_scale)
     if not adam_w_mode and weight_decay != 0.0:
         g = g + weight_decay * p  # L2 mode ≡ ADAM_MODE_1 (multi_tensor_adam.cu)
-    m_new = beta1 * m + (1.0 - beta1) * g
-    v_new = beta2 * v + (1.0 - beta2) * g * g
-    if bias_correction:
-        mhat = m_new / bc1
-        vhat = v_new / bc2
-    else:
-        mhat, vhat = m_new, v_new
-    update = mhat / (jnp.sqrt(vhat) + eps)
+    m_new = b1e * m + c1 * g
+    v_new = b2e * v + c2 * (g * g)
+    update = (m_new * rbc1) / (jnp.sqrt(v_new * rbc2) + eps)
     if adam_w_mode and weight_decay != 0.0:
         update = update + weight_decay * p  # AdamW ≡ ADAM_MODE_0
-    p_new = p - lr * update
-    keep = found_inf > 0.5
-    p_out[...] = jnp.where(keep, p, p_new).astype(p_out.dtype)
-    m_out[...] = jnp.where(keep, m, m_new).astype(m_out.dtype)
-    v_out[...] = jnp.where(keep, v, v_new).astype(v_out.dtype)
+    p_out[...] = (p - lr_eff * update).astype(p_out.dtype)
+    m_out[...] = m_new.astype(m_out.dtype)
+    v_out[...] = v_new.astype(v_out.dtype)
 
 
 def adam_flat(p, m, v, g, lr, step, *, beta1=0.9, beta2=0.999, eps=1e-8,
@@ -123,21 +127,33 @@ def adam_flat(p, m, v, g, lr, step, *, beta1=0.9, beta2=0.999, eps=1e-8,
     Returns (p, m, v) new buffers (donate inputs under jit).
     """
     step = jnp.asarray(step, jnp.float32)
-    bc1 = 1.0 - jnp.power(jnp.float32(beta1), step)
-    bc2 = 1.0 - jnp.power(jnp.float32(beta2), step)
+    # clamp: at step 0 (reachable only when found_inf skips the very
+    # first update, so m=v=0) bc would be 0 and 1/bc inf — inf*0=nan
+    # would poison the select-free kernel
+    bc1 = jnp.maximum(1.0 - jnp.power(jnp.float32(beta1), step), 1e-20)
+    bc2 = jnp.maximum(1.0 - jnp.power(jnp.float32(beta2), step), 1e-20)
+    one = jnp.float32(1.0)
+    keep = jnp.asarray(found_inf).astype(jnp.bool_)
+    # fold overflow-skip + bias correction into broadcast scalars: the
+    # kernel then runs select-free and divide-free (one vector divide
+    # left) — see _adam_kernel
     scalars = jnp.stack([
-        jnp.asarray(lr, jnp.float32),
+        jnp.where(keep, 0.0, jnp.asarray(lr, jnp.float32)),   # lr_eff
         jnp.asarray(inv_scale, jnp.float32),
-        jnp.asarray(found_inf, jnp.float32),
-        bc1, bc2,
-    ]).reshape(5, 1)
+        jnp.where(keep, one, jnp.float32(beta1)),             # b1e
+        jnp.where(keep, 0.0, 1.0 - jnp.float32(beta1)),       # c1
+        jnp.where(keep, one, jnp.float32(beta2)),             # b2e
+        jnp.where(keep, 0.0, 1.0 - jnp.float32(beta2)),       # c2
+        one / bc1 if bias_correction else one,                # rbc1
+        one / bc2 if bias_correction else one,                # rbc2
+        keep.astype(jnp.float32),                             # found
+    ]).reshape(9, 1)
     if not use_pallas(use_pallas_override):
-        return _adam_reference(p, m, v, g, scalars, beta1, beta2, eps,
-                               weight_decay, adam_w_mode, bias_correction)
+        return _adam_reference(p, m, v, g, scalars, eps,
+                               weight_decay, adam_w_mode)
     kernel = functools.partial(
-        _adam_kernel, beta1=beta1, beta2=beta2, eps=eps,
-        weight_decay=weight_decay, adam_w_mode=adam_w_mode,
-        bias_correction=bias_correction)
+        _adam_kernel, eps=eps,
+        weight_decay=weight_decay, adam_w_mode=adam_w_mode)
     p2, np_ = _to2d(p)
     m2, _ = _to2d(m)
     v2, _ = _to2d(v)
@@ -145,7 +161,7 @@ def adam_flat(p, m, v, g, lr, step, *, beta1=0.9, beta2=0.999, eps=1e-8,
     rows = p2.shape[0]
     grid = rows // _BLOCK_ROWS
     spec = pl.BlockSpec((_BLOCK_ROWS, _LANES), lambda i: (i, 0))
-    sspec = pl.BlockSpec((5, 1), lambda i: (0, 0))
+    sspec = pl.BlockSpec((9, 1), lambda i: (0, 0))
     pn, mn, vn = pl.pallas_call(
         kernel,
         grid=(grid,),
@@ -160,27 +176,24 @@ def adam_flat(p, m, v, g, lr, step, *, beta1=0.9, beta2=0.999, eps=1e-8,
     return _from2d(pn, np_), _from2d(mn, np_), _from2d(vn, np_)
 
 
-def _adam_reference(p, m, v, g, scalars, beta1, beta2, eps, weight_decay,
-                    adam_w_mode, bias_correction):
-    lr, inv_scale, found_inf, bc1, bc2 = [scalars[i, 0] for i in range(5)]
-    g = g.astype(jnp.float32) * inv_scale
+def _adam_reference(p, m, v, g, scalars, eps, weight_decay, adam_w_mode):
+    """Same folded-scalar contract as _adam_kernel (the CPU oracle)."""
+    (lr_eff, inv_scale, b1e, c1, b2e, c2, rbc1, rbc2, found) = [
+        scalars[i, 0] for i in range(9)]
+    g = jnp.where(found > 0.5, 0.0, g.astype(jnp.float32) * inv_scale)
     p32 = p.astype(jnp.float32)
     if not adam_w_mode and weight_decay:
         g = g + weight_decay * p32
     m32 = m.astype(jnp.float32)
     v32 = v.astype(jnp.float32)
-    m_new = beta1 * m32 + (1 - beta1) * g
-    v_new = beta2 * v32 + (1 - beta2) * g * g
-    mhat = m_new / bc1 if bias_correction else m_new
-    vhat = v_new / bc2 if bias_correction else v_new
-    update = mhat / (jnp.sqrt(vhat) + eps)
+    m_new = b1e * m32 + c1 * g
+    v_new = b2e * v32 + c2 * (g * g)
+    update = (m_new * rbc1) / (jnp.sqrt(v_new * rbc2) + eps)
     if adam_w_mode and weight_decay:
         update = update + weight_decay * p32
-    p_new = p32 - lr * update
-    keep = found_inf > 0.5
-    return (jnp.where(keep, p32, p_new).astype(p.dtype),
-            jnp.where(keep, m32, m_new).astype(m.dtype),
-            jnp.where(keep, v32, v_new).astype(v.dtype))
+    p_new = p32 - lr_eff * update
+    return (p_new.astype(p.dtype), m_new.astype(m.dtype),
+            v_new.astype(v.dtype))
 
 
 # ------------------------------- SGD ----------------------------------------
@@ -338,21 +351,18 @@ def adagrad_flat(p, h, g, lr, *, eps=1e-10, weight_decay=0.0,
 # ------------------------- LAMB (two-phase) ---------------------------------
 
 def _lamb_phase1_kernel(m_ref, v_ref, g_ref, p_ref, sc_ref,
-                        m_out, v_out, u_out, *,
-                        beta1, beta2, beta3, eps, weight_decay,
-                        bias_correction):
+                        m_out, v_out, u_out, *, eps, weight_decay):
     """Phase 1 ≡ amp_C.multi_tensor_lamb_stage1 / lamb stage computing the
     raw update u = mhat/(sqrt(vhat)+eps) + wd*p with global-grad-norm
-    clipping fused (sc rows: [clip_ratio, bc1, bc2]).  beta3 is the grad
-    coefficient of the m update: 1-beta1 under grad averaging, else 1
-    (≡ the reference's beta3 in multi_tensor_lamb.cu)."""
-    g = g_ref[...].astype(jnp.float32) * sc_ref[0, 0]
+    clipping fused.  sc rows: [g_scale, b1e, c1, b2e, c2, rbc1, rbc2,
+    found] — overflow skip + bias correction folded into scalars like
+    _adam_kernel (one g select; reciprocal-multiply bias correction)."""
+    g = g_ref[...].astype(jnp.float32)
     p = p_ref[...].astype(jnp.float32)
-    m_new = beta1 * m_ref[...] + beta3 * g
-    v_new = beta2 * v_ref[...] + (1.0 - beta2) * g * g
-    mhat = m_new / sc_ref[1, 0] if bias_correction else m_new
-    vhat = v_new / sc_ref[2, 0] if bias_correction else v_new
-    u = mhat / (jnp.sqrt(vhat) + eps)
+    g = jnp.where(sc_ref[7, 0] > 0.5, 0.0, g * sc_ref[0, 0])
+    m_new = sc_ref[1, 0] * m_ref[...] + sc_ref[2, 0] * g
+    v_new = sc_ref[3, 0] * v_ref[...] + sc_ref[4, 0] * (g * g)
+    u = (m_new * sc_ref[5, 0]) / (jnp.sqrt(v_new * sc_ref[6, 0]) + eps)
     if weight_decay != 0.0:
         u = u + weight_decay * p
     m_out[...] = m_new
@@ -370,34 +380,50 @@ def _lamb_phase2_kernel(p_ref, u_ref, r_ref, sc_ref, p_out):
 
 def lamb_phase1_flat(m, v, g, p, clip_ratio, step, *, beta1, beta2, eps,
                      weight_decay, bias_correction=True,
-                     grad_averaging=True, use_pallas_override=None):
+                     grad_averaging=True, inv_scale=1.0, found_inf=False,
+                     use_pallas_override=None):
+    """`g` may ride in its native (bf16) dtype — the kernel upcasts per
+    block.  inv_scale and the overflow skip are folded into the scalar
+    rows (≡ the capturable CUDA-graph LAMB), so callers need no extra
+    whole-buffer passes for unscale or skip-masking."""
     beta3 = (1.0 - beta1) if grad_averaging else 1.0
     step = jnp.asarray(step, jnp.float32)
-    bc1 = 1.0 - jnp.power(jnp.float32(beta1), step)
-    bc2 = 1.0 - jnp.power(jnp.float32(beta2), step)
-    scalars = jnp.stack([jnp.asarray(clip_ratio, jnp.float32), bc1,
-                         bc2]).reshape(3, 1)
+    bc1 = jnp.maximum(1.0 - jnp.power(jnp.float32(beta1), step), 1e-20)
+    bc2 = jnp.maximum(1.0 - jnp.power(jnp.float32(beta2), step), 1e-20)
+    one = jnp.float32(1.0)
+    keep = jnp.asarray(found_inf).astype(jnp.bool_)
+    g_scale = (jnp.asarray(clip_ratio, jnp.float32)
+               * jnp.asarray(inv_scale, jnp.float32))
+    scalars = jnp.stack([
+        g_scale,
+        jnp.where(keep, one, jnp.float32(beta1)),          # b1e
+        jnp.where(keep, 0.0, jnp.float32(beta3)),          # c1
+        jnp.where(keep, one, jnp.float32(beta2)),          # b2e
+        jnp.where(keep, 0.0, 1.0 - jnp.float32(beta2)),    # c2
+        one / bc1 if bias_correction else one,             # rbc1
+        one / bc2 if bias_correction else one,             # rbc2
+        keep.astype(jnp.float32),                          # found
+    ]).reshape(8, 1)
     if not use_pallas(use_pallas_override):
-        g32 = g.astype(jnp.float32) * scalars[0, 0]
+        g32 = jnp.where(scalars[7, 0] > 0.5, 0.0,
+                        g.astype(jnp.float32) * scalars[0, 0])
         p32 = p.astype(jnp.float32)
-        m_new = beta1 * m + beta3 * g32
-        v_new = beta2 * v + (1 - beta2) * g32 * g32
-        mhat = m_new / bc1 if bias_correction else m_new
-        vhat = v_new / bc2 if bias_correction else v_new
-        u = mhat / (jnp.sqrt(vhat) + eps)
+        m_new = scalars[1, 0] * m + scalars[2, 0] * g32
+        v_new = scalars[3, 0] * v + scalars[4, 0] * (g32 * g32)
+        u = (m_new * scalars[5, 0]) / (
+            jnp.sqrt(v_new * scalars[6, 0]) + eps)
         if weight_decay:
             u = u + weight_decay * p32
         return m_new, v_new, u
     kernel = functools.partial(
-        _lamb_phase1_kernel, beta1=beta1, beta2=beta2, beta3=beta3, eps=eps,
-        weight_decay=weight_decay, bias_correction=bias_correction)
+        _lamb_phase1_kernel, eps=eps, weight_decay=weight_decay)
     m2, n = _to2d(m)
     v2, _ = _to2d(v)
     g2, _ = _to2d(g)
     p2, _ = _to2d(p)
     grid = m2.shape[0] // _BLOCK_ROWS
     spec = pl.BlockSpec((_BLOCK_ROWS, _LANES), lambda i: (i, 0))
-    sspec = pl.BlockSpec((3, 1), lambda i: (0, 0))
+    sspec = pl.BlockSpec((8, 1), lambda i: (0, 0))
     mn, vn, u = pl.pallas_call(
         kernel,
         grid=(grid,),
@@ -408,6 +434,74 @@ def lamb_phase1_flat(m, v, g, p, clip_ratio, step, *, beta1, beta2, eps,
         interpret=pallas_interpret(),
     )(m2, v2, g2, p2, scalars)
     return _from2d(mn, n), _from2d(vn, n), _from2d(u, n)
+
+
+def _lamb_phase2_seg_kernel(p_ref, u_ref, lo_ref, hi_ref, vals_ref,
+                            sc_ref, off_ref, p_out, *, npad, R):
+    """Phase 2 with IN-KERNEL trust-ratio expansion: the per-tensor
+    ratio row vector is rebuilt per block via the bounds one-hot matmul
+    (same trick as _rows_sumsq_seg_kernel, transposed) — the (total,)
+    per-element ratio vector never exists in HBM."""
+    i = pl.program_id(0)
+    lr = sc_ref[0, 0]
+    oh = _block_onehot(lo_ref, hi_ref, off_ref, i, R, npad)
+    # exactly one 1 per row → this dot is a SELECT of vals; HIGHEST
+    # keeps the selected fp32 ratio exact (default = bf16 rounding)
+    ratio_row = jax.lax.dot_general(
+        oh, vals_ref[0:1, :], (((1,), (1,)), ((), ())),
+        precision=jax.lax.Precision.HIGHEST)              # (R, 1)
+    p = p_ref[...].astype(jnp.float32)
+    p_out[...] = (p - lr * ratio_row * u_ref[...]).astype(p_out.dtype)
+
+
+def lamb_phase2_seg(p, u, ratio_values, spec, lr, *, row_offset=0,
+                    padded_total=None, use_pallas_override=None):
+    """p -= lr * trust_ratio[tensor] * u with per-tensor `ratio_values`
+    ((n_seg,)) expanded in-kernel from the spec's static row bounds.
+    `row_offset` is p's global starting row (rank*shard_rows for a
+    shard; may be traced — `padded_total` must then be given for the
+    fallback's segment map).  Rows outside every tensor (tail padding)
+    get ratio 0, leaving them untouched."""
+    n_seg = ratio_values.shape[0]
+    npad = _seg_pad(n_seg)
+    if not (use_pallas(use_pallas_override) and n_seg + 1 < _SEG_CAP
+            and p.shape[0] % FLAT_TILE == 0):
+        rows = p.shape[0] // _LANES
+        total = padded_total if padded_total is not None else p.shape[0]
+        rank = jnp.asarray(row_offset, jnp.int32) // rows
+        seg = shard_segment_ids(spec, rank, rows, total)
+        vals = jnp.concatenate(
+            [ratio_values.astype(jnp.float32),
+             jnp.zeros((1,), jnp.float32)])  # dummy tail ratio 0
+        per_row = vals[seg]
+        ratio_elem = jnp.broadcast_to(
+            per_row[:, None], (per_row.shape[0], _LANES)).reshape(-1)
+        return lamb_phase2_flat(p, u, ratio_elem, lr,
+                                use_pallas_override=use_pallas_override)
+    p2, n = _to2d(p)
+    u2, _ = _to2d(u)
+    R = _BLOCK_ROWS
+    nb = p2.shape[0] // R
+    lo, hi = _seg_row_bounds(spec, npad)
+    vals8 = jnp.broadcast_to(
+        jnp.pad(ratio_values.astype(jnp.float32),
+                (0, npad - n_seg))[None, :], (8, npad))
+    scalars = jnp.asarray(lr, jnp.float32).reshape(1, 1)
+    off = jnp.asarray(row_offset, jnp.int32).reshape(1, 1)
+    bspec = pl.BlockSpec((8, npad), lambda i: (0, 0))
+    sspec = pl.BlockSpec((1, 1), lambda i: (0, 0))
+    pn = pl.pallas_call(
+        functools.partial(_lamb_phase2_seg_kernel, npad=npad, R=R),
+        grid=(nb,),
+        in_specs=[pl.BlockSpec((R, _LANES), lambda i: (i, 0)),
+                  pl.BlockSpec((R, _LANES), lambda i: (i, 0)),
+                  bspec, bspec, bspec, sspec, sspec],
+        out_specs=pl.BlockSpec((R, _LANES), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct(p2.shape, p2.dtype),
+        input_output_aliases={0: 0},
+        interpret=pallas_interpret(),
+    )(p2, u2, lo, hi, vals8, scalars, off)
+    return _from2d(pn, n)
 
 
 def lamb_phase2_flat(p, u, ratio_elem, lr, use_pallas_override=None):
@@ -487,10 +581,109 @@ def _row_segment_ids(spec):
     return _np.repeat(_np.arange(len(rows), dtype=_np.int32), rows)
 
 
-def per_tensor_l2norm_aligned(flat, spec):
+# Per-tensor segment reductions over the flat buffer.  TPU scatter (the
+# jax.ops.segment_sum lowering) and big gathers are VPU-serial — at
+# BERT-Large scale one segment_sum over 2.6M rows measured 36 ms and the
+# values[seg] expand gather 35 ms, dwarfing the optimizer math itself.
+# Segments are CONTIGUOUS row runs, so each (rows, 128) block can turn
+# its row sums into per-tensor partials with ONE one-hot matmul on the
+# MXU ((R, 1)^T-dot-(R, n_seg) from an iota==seg compare); a VMEM
+# accumulator carries partials across the sequential grid.  ≡ the
+# two-phase multi_tensor_l2norm reduction (csrc/multi_tensor_l2norm.cu)
+# re-shaped for the MXU.
+
+_SEG_CAP = 2048  # one-hot width cap; fall back to segment_sum beyond
+
+
+def _seg_pad(n_seg):
+    return max(_LANES, -(-(n_seg + 1) // _LANES) * _LANES)
+
+
+def _seg_row_bounds(spec, npad):
+    """Per-tensor [start, end) ROW bounds as (8, npad) int32 blocks (row
+    0 is real; broadcast to the fp32 min-tile height).  The contiguous
+    layout means segment membership is two compares against these
+    bounds — no per-row segment-id array, no gather.  Unused columns get
+    a sentinel past any row index."""
+    import numpy as _np
+    assert spec.align % _LANES == 0, "spec must be lane-aligned"
+    n_seg = len(spec.sizes)
+    starts = _np.full((npad,), 2 ** 30, _np.int32)
+    ends = _np.full((npad,), 2 ** 30, _np.int32)
+    bounds = list(spec.offsets) + [spec.total]
+    for s in range(n_seg):
+        starts[s] = bounds[s] // _LANES
+        ends[s] = bounds[s + 1] // _LANES
+    lo = jnp.broadcast_to(jnp.asarray(starts)[None, :], (8, npad))
+    hi = jnp.broadcast_to(jnp.asarray(ends)[None, :], (8, npad))
+    return lo, hi
+
+
+def _block_onehot(lo_ref, hi_ref, off_ref, i, R, npad):
+    """(R, npad) one-hot of global-row-in-segment for grid block i."""
+    rowg = (off_ref[0, 0] + i * R
+            + lax.broadcasted_iota(jnp.int32, (R, 1), 0))
+    return ((rowg >= lo_ref[0:1, :]) & (rowg < hi_ref[0:1, :])
+            ).astype(jnp.float32)
+
+
+def _rows_sumsq_seg_kernel(x_ref, lo_ref, hi_ref, off_ref, out_ref, acc,
+                           *, nb, npad, R):
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        acc[...] = jnp.zeros_like(acc)
+
+    xb = x_ref[...].astype(jnp.float32)
+    rq = jnp.sum(xb * xb, axis=1, keepdims=True)            # (R, 1)
+    oh = _block_onehot(lo_ref, hi_ref, off_ref, i, R, npad)
+    # HIGHEST: the default MXU fp32 path is a single bf16 pass, which
+    # rounds the row sums to ~8 mantissa bits — trust ratios then drift
+    # ~4e-4 vs the jnp oracle
+    acc[0:1, :] += jax.lax.dot_general(
+        rq, oh, (((0,), (0,)), ((), ())),
+        precision=jax.lax.Precision.HIGHEST)
+
+    @pl.when(i == nb - 1)
+    def _done():
+        out_ref[...] = acc[...]
+
+
+def _per_tensor_sumsq_2d(x2, spec, n_seg, row_offset):
+    """(rows, 128) buffer → (n_seg,) sums of squares via per-block
+    one-hot matmuls.  `row_offset` is this buffer's global starting row
+    (0 for a full buffer; rank*shard_rows for a shard — may be traced)."""
+    rows = x2.shape[0]
+    R = _BLOCK_ROWS
+    nb = rows // R
+    npad = _seg_pad(n_seg)
+    lo, hi = _seg_row_bounds(spec, npad)
+    off = jnp.asarray(row_offset, jnp.int32).reshape(1, 1)
+    bspec = pl.BlockSpec((8, npad), lambda i: (0, 0))
+    out = pl.pallas_call(
+        functools.partial(_rows_sumsq_seg_kernel, nb=nb, npad=npad, R=R),
+        grid=(nb,),
+        in_specs=[pl.BlockSpec((R, _LANES), lambda i: (i, 0)),
+                  bspec, bspec,
+                  pl.BlockSpec((1, 1), lambda i: (0, 0))],
+        out_specs=pl.BlockSpec((8, npad), lambda i: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((8, npad), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((8, npad), jnp.float32)],
+        interpret=pallas_interpret(),
+    )(x2, lo, hi, off)
+    return out[0, :n_seg]
+
+
+def per_tensor_l2norm_aligned(flat, spec, use_pallas_override=None):
     """Per-tensor L2 norms over a lane-aligned flat buffer; `spec.align`
     must be a multiple of the 128-lane width."""
     assert spec.align % _LANES == 0, "spec must be lane-aligned"
+    n_seg = len(spec.sizes)
+    if (use_pallas(use_pallas_override) and n_seg < _SEG_CAP
+            and flat.shape[0] % FLAT_TILE == 0):
+        x2 = flat.reshape(-1, _LANES)
+        return jnp.sqrt(_per_tensor_sumsq_2d(x2, spec, n_seg, 0))
     x2 = flat[: spec.total].reshape(-1, _LANES).astype(jnp.float32)
     rowsq = jnp.sum(x2 * x2, axis=1)                      # (rows,)
     seg = jnp.asarray(_row_segment_ids(spec))             # static constant
@@ -538,14 +731,23 @@ def shard_segment_ids(spec, rank, rows_shard, padded_total):
                                  (rows_shard,))
 
 
-def per_tensor_sumsq_shard(shard, spec, seg):
+def per_tensor_sumsq_shard(shard, spec, rank, padded_total,
+                           use_pallas_override=None):
     """Per-tensor PARTIAL sums of squares over ONE rank's contiguous
-    flat shard (`seg` from shard_segment_ids).  A psum over the shard
-    axis yields the exact full-buffer per-tensor sums — no rank ever
-    materializes the full buffer (≡ the reference's pipelined
-    block-reduction L2 norms, distributed_fused_lamb.py:728-987, which
-    exist for the same reason).  Returns (n_tensors,) fp32 partial sums;
-    the dummy tail segment (zero padding) is dropped."""
+    flat shard (shards partition the `padded_total`-long buffer evenly;
+    `rank` may be traced).  A psum over the shard axis yields the exact
+    full-buffer per-tensor sums — no rank ever materializes the full
+    buffer (≡ the reference's pipelined block-reduction L2 norms,
+    distributed_fused_lamb.py:728-987, which exist for the same reason).
+    Returns (n_tensors,) fp32 partial sums; tail-padding rows fall
+    outside every bound and contribute nothing."""
+    n_seg = len(spec.sizes)
+    rows_shard = shard.shape[0] // _LANES
+    if (use_pallas(use_pallas_override) and n_seg + 1 < _SEG_CAP
+            and shard.shape[0] % FLAT_TILE == 0):
+        x2 = shard.reshape(-1, _LANES)
+        return _per_tensor_sumsq_2d(x2, spec, n_seg, rank * rows_shard)
+    seg = shard_segment_ids(spec, rank, rows_shard, padded_total)
     x2 = shard.reshape(-1, _LANES).astype(jnp.float32)
     rowsq = jnp.sum(x2 * x2, axis=1)                      # (rows,)
     sums = jax.ops.segment_sum(rowsq, seg,
@@ -556,7 +758,9 @@ def per_tensor_sumsq_shard(shard, spec, seg):
 def expand_per_tensor_shard(values, seg):
     """Broadcast per-tensor scalars to ONE rank's shard elements —
     the shard-local counterpart of expand_per_tensor_aligned (padding
-    rows broadcast 1.0, harmless on zero-padded updates)."""
+    rows broadcast 1.0, harmless on zero-padded updates).  Prefer
+    lamb_phase2_seg, which folds the expansion into the update kernel
+    and never materializes the per-element vector."""
     rows_shard = seg.shape[0]
     vals = jnp.concatenate(
         [values.astype(jnp.float32), jnp.ones((1,), jnp.float32)])

@@ -175,8 +175,7 @@ class DistributedFusedLAMB(_ShardedFlat):
 
     def step(self, state, grads, lr=None, inv_scale=1.0, found_inf=False):
         ax = self.axis_name
-        g_flat = self._flatten(grads) * jnp.asarray(
-            inv_scale, jnp.float32)
+        g_flat = self._flatten(grads)
         g_shard = lax.psum_scatter(g_flat, ax, scatter_dimension=0,
                                    tiled=True) / self.num_shards
         found = jnp.asarray(found_inf)
@@ -184,18 +183,23 @@ class DistributedFusedLAMB(_ShardedFlat):
         lr_val = self.lr if lr is None else lr
 
         # global grad norm over ALL shards (pipelined block reductions in
-        # the reference, distributed_fused_lamb.py:728-987 → one psum)
-        gnorm = jnp.sqrt(lax.psum(jnp.sum(jnp.square(g_shard)), ax))
+        # the reference, distributed_fused_lamb.py:728-987 → one psum);
+        # inv_scale multiplies the homogeneous norm and otherwise rides
+        # inside phase 1's g_scale scalar — no whole-buffer unscale pass
+        gnorm = jnp.sqrt(lax.psum(jnp.sum(jnp.square(g_shard)), ax)
+                         ) * jnp.asarray(inv_scale, jnp.float32)
         clip = jnp.where(
             (self.max_grad_norm > 0) & (gnorm > self.max_grad_norm),
             self.max_grad_norm / gnorm, 1.0)
 
+        # overflow skip folded into the kernels (≡ FusedLAMB.step)
         m, v, u = K.lamb_phase1_flat(
             state.exp_avg, state.exp_avg_sq, g_shard, state.params_shard,
             clip_ratio=clip, step=step_next.astype(jnp.float32),
             beta1=self.beta1, beta2=self.beta2, eps=self.eps,
             weight_decay=self.weight_decay,
             bias_correction=self.bias_correction,
+            inv_scale=inv_scale, found_inf=found,
             use_pallas_override=self.use_pallas)
 
         # per-tensor norms WITHOUT materializing the full buffers: each
@@ -208,24 +212,25 @@ class DistributedFusedLAMB(_ShardedFlat):
         # full-size all-gather left in the step is the final param sync.
         shard_size = self.padded_total // self.num_shards
         rank = lax.axis_index(ax)
-        seg = K.shard_segment_ids(self.spec, rank, shard_size // K._LANES,
-                                  self.padded_total)
-        pn_part = K.per_tensor_sumsq_shard(state.params_shard, self.spec,
-                                           seg)
-        un_part = K.per_tensor_sumsq_shard(u, self.spec, seg)
+        pn_part = K.per_tensor_sumsq_shard(
+            state.params_shard, self.spec, rank, self.padded_total,
+            use_pallas_override=self.use_pallas)
+        un_part = K.per_tensor_sumsq_shard(
+            u, self.spec, rank, self.padded_total,
+            use_pallas_override=self.use_pallas)
         sums = lax.psum(jnp.concatenate([pn_part, un_part]), ax)
         n_t = len(self.spec.sizes)
         wn = jnp.sqrt(sums[:n_t])
         un = jnp.sqrt(sums[n_t:])
         ratio = jnp.where((wn > 0) & (un > 0), wn / jnp.maximum(un, 1e-12),
                           1.0)
-        ratio_shard = K.expand_per_tensor_shard(ratio, seg)
 
-        p_new = K.lamb_phase2_flat(state.params_shard, u, ratio_shard,
-                                   lr_val, use_pallas_override=self.use_pallas)
-        p = jnp.where(found, state.params_shard, p_new)
-        m = jnp.where(found, state.exp_avg, m)
-        v = jnp.where(found, state.exp_avg_sq, v)
+        lr_eff = jnp.where(found, 0.0, jnp.asarray(lr_val, jnp.float32))
+        p = K.lamb_phase2_seg(state.params_shard, u, ratio, self.spec,
+                              lr_eff,
+                              row_offset=rank * (shard_size // K._LANES),
+                              padded_total=self.padded_total,
+                              use_pallas_override=self.use_pallas)
         new_state = DistributedFusedLAMBState(
             step=step_next, params_shard=p, exp_avg=m, exp_avg_sq=v)
         return self._gather_full(p), new_state

@@ -81,20 +81,26 @@ def flatten(tree, dtype=jnp.float32, pad_to: int = 1, align: int = 1):
 def unflatten(flat, spec: FlatSpec, cast_to_leaf_dtype: bool = True):
     """Rebuild the pytree from a flat buffer (XLA: pure slicing, fused).
 
-    When the leaves are cast (fp32 master → bf16 model dtype), an
-    optimization barrier sits between each slice and its convert: XLA
-    otherwise CSE-hoists the ~hundreds of slice→convert pairs into one
-    whole-buffer 1-D bf16 convert, for which it can pick a
-    [N/2, 2]-shaped layout whose (8,128) tiling pads the minor dim 2 up
-    to 128 — a 64x HBM blowup (43 GB for a 336M-param BERT) that OOMs at
-    compile time.  The barrier keeps the converts leaf-sized.
+    An optimization barrier sits between each slice and its
+    convert/reshape: XLA otherwise CSE-hoists the ~hundreds of
+    slice→convert/reshape chains into whole-buffer temps —
+    * cast case: one 1-D bf16 convert whose [N/2, 2] layout tile-pads
+      the minor dim 2 up to 128, a 64x HBM blowup (43 GB at 336M) that
+      OOMs compilation;
+    * same-dtype case: one whole-buffer RESHAPE per distinct leaf minor
+      width (observed at 1.3B: two 2.44 GB bf16 relayout temps,
+      [N/8192, 8192] and [N/2048, 2048] views of the master buffer —
+      the step OOM'd at batch 8 and the standalone unflatten ran at
+      23 GB/s).
+    The barrier keeps every convert/reshape leaf-sized.
     """
     leaves = []
     for shape, dt, size, off in zip(spec.shapes, spec.dtypes, spec.sizes,
                                     spec.offsets):
         leaf = jax.lax.dynamic_slice(flat, (off,), (size,))
+        leaf = jax.lax.optimization_barrier(leaf)
         if cast_to_leaf_dtype and dt != flat.dtype:
-            leaf = jax.lax.optimization_barrier(leaf).astype(dt)
+            leaf = leaf.astype(dt)
         leaves.append(leaf.reshape(shape))
     return jax.tree_util.tree_unflatten(spec.treedef, leaves)
 

@@ -14,6 +14,7 @@ from __future__ import annotations
 
 from typing import NamedTuple, Optional
 
+import jax
 import jax.numpy as jnp
 
 from apex_tpu.ops import optimizer_kernels as K
@@ -59,21 +60,28 @@ class FusedLAMB(F.FlatCheckpointMixin):
 
     def step(self, state: FusedLAMBState, grads, lr=None, inv_scale=1.0,
              found_inf=False):
-        g_flat = F.flatten(grads, jnp.float32, pad_to=K.FLAT_TILE,
-                           align=K._LANES) * jnp.asarray(
-            inv_scale, jnp.float32)
+        # native-dtype grad flatten (the kernels upcast per block;
+        # halving the bf16 grad traffic beats a pre-cast) and NO
+        # inv_scale pass — it folds into phase 1's g_scale scalar
+        gdts = {l.dtype for l in jax.tree_util.tree_leaves(grads)}
+        gdt = gdts.pop() if len(gdts) == 1 else jnp.float32
+        g_flat = F.flatten(grads, gdt, pad_to=K.FLAT_TILE,
+                           align=K._LANES)
         found = jnp.asarray(found_inf)
         step_next = state.step + jnp.where(found, 0, 1).astype(jnp.int32)
         lr_val = self.lr if lr is None else lr
 
         # phase 0: global grad norm + clip ratio (fused_lamb.py:124-133,
-        # 169-181: clip when norm > max_grad_norm)
-        gnorm = K.l2norm_flat(g_flat)
+        # 169-181: clip when norm > max_grad_norm); the norm is
+        # homogeneous so unscaling multiplies it
+        gnorm = K.l2norm_flat(g_flat) * jnp.asarray(inv_scale, jnp.float32)
         if self.max_grad_norm and self.max_grad_norm > 0:
             clip = jnp.where(gnorm > self.max_grad_norm,
                              self.max_grad_norm / gnorm, 1.0)
         else:
             clip = jnp.float32(1.0)
+        # overflow skip rides inside the kernels (lr_eff=0 / moment
+        # coefficients folded) — no whole-buffer where-masks
         m, v, u = K.lamb_phase1_flat(
             state.exp_avg, state.exp_avg_sq, g_flat, state.params,
             clip_ratio=clip, step=step_next.astype(jnp.float32),
@@ -81,23 +89,21 @@ class FusedLAMB(F.FlatCheckpointMixin):
             weight_decay=self.weight_decay,
             bias_correction=self.bias_correction,
             grad_averaging=self.grad_averaging,
+            inv_scale=inv_scale, found_inf=found,
             use_pallas_override=self.use_pallas)
 
         # per-tensor trust ratios ≡ the lamb kernel's
-        # ratio = w_norm / u_norm when both > 0 else 1
-        wn = K.per_tensor_l2norm_aligned(state.params, self.spec)
-        un = K.per_tensor_l2norm_aligned(u, self.spec)
+        # ratio = w_norm / u_norm when both > 0 else 1 — one-hot MXU
+        # segment sums (ops/optimizer_kernels.py), not scatter/gather
+        wn = K.per_tensor_l2norm_aligned(
+            state.params, self.spec, use_pallas_override=self.use_pallas)
+        un = K.per_tensor_l2norm_aligned(
+            u, self.spec, use_pallas_override=self.use_pallas)
         ratio = jnp.where((wn > 0) & (un > 0), wn / jnp.maximum(un, 1e-12),
                           1.0)
-        ratio_elem = K.expand_per_tensor_aligned(ratio, self.spec,
-                                                 state.params.shape[0])
-
-        p_new = K.lamb_phase2_flat(state.params, u, ratio_elem, lr_val,
-                                   use_pallas_override=self.use_pallas)
-        # overflow skip: masked update
-        p = jnp.where(found, state.params, p_new)
-        m = jnp.where(found, state.exp_avg, m)
-        v = jnp.where(found, state.exp_avg_sq, v)
+        lr_eff = jnp.where(found, 0.0, jnp.asarray(lr_val, jnp.float32))
+        p = K.lamb_phase2_seg(state.params, u, ratio, self.spec, lr_eff,
+                              use_pallas_override=self.use_pallas)
         new_state = FusedLAMBState(step=step_next, params=p, exp_avg=m,
                                    exp_avg_sq=v)
         return F.unflatten(p, self.spec), new_state

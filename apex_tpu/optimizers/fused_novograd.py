@@ -66,7 +66,8 @@ class FusedNovoGrad(F.FlatCheckpointMixin):
 
         # per-tensor ||g||^2 EMA (fused_novograd.py: v init at first step
         # with the raw norm unless init_zero)
-        gn2 = jnp.square(K.per_tensor_l2norm_aligned(g_flat, self.spec))
+        gn2 = jnp.square(K.per_tensor_l2norm_aligned(
+            g_flat, self.spec, use_pallas_override=self.use_pallas))
         first = state.step == 0
         if self.init_zero:
             v_prev = state.exp_avg_sq

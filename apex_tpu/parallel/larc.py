@@ -15,7 +15,8 @@ import numpy as np
 
 
 def larc_adjust_grads(params, grads, lr, *, trust_coefficient=0.02,
-                      clip=True, eps=1e-8, weight_decay=0.0):
+                      clip=True, eps=1e-8, weight_decay=0.0,
+                      use_pallas=None):
     """Return LARC-adjusted grads (per-tensor adaptive scaling).
 
     All per-tensor norms come from ONE row-aligned segment-sum pass over
@@ -28,9 +29,11 @@ def larc_adjust_grads(params, grads, lr, *, trust_coefficient=0.02,
 
     spec = F.make_spec(params, align=K._LANES)
     pn = K.per_tensor_l2norm_aligned(
-        F.flatten(params, jnp.float32, align=K._LANES), spec)
+        F.flatten(params, jnp.float32, align=K._LANES,
+                  pad_to=K.FLAT_TILE), spec, use_pallas_override=use_pallas)
     gn = K.per_tensor_l2norm_aligned(
-        F.flatten(grads, jnp.float32, align=K._LANES), spec)
+        F.flatten(grads, jnp.float32, align=K._LANES,
+                  pad_to=K.FLAT_TILE), spec, use_pallas_override=use_pallas)
     local_lr = trust_coefficient * pn / (gn + weight_decay * pn + eps)
     # skip adaptation when either norm is 0 (LARC.py:92-96)
     local_lr = jnp.where((pn > 0) & (gn > 0), local_lr, 1.0)
@@ -77,7 +80,8 @@ class LARC:
         adjusted = larc_adjust_grads(
             params, grads, lr_val,
             trust_coefficient=self.trust_coefficient, clip=self.clip,
-            eps=self.eps, weight_decay=wd)
+            eps=self.eps, weight_decay=wd,
+            use_pallas=getattr(self.optim, "use_pallas", None))
         # weight decay already applied to grads (reference zeroes it in
         # the wrapped optimizer during step, LARC.py:87-106)
         saved_wd = getattr(self.optim, "weight_decay", None)

@@ -41,7 +41,8 @@ def _time_steps(step, state, tokens, labels, iters, warmup):
     return (time.perf_counter() - t0) / iters
 
 
-def _fused_tokens_per_sec(on_tpu, batch, seq, cfg):
+def _fused_tokens_per_sec(on_tpu, batch, seq, cfg,
+                          master_dtype=jnp.float32):
     from apex_tpu.models.gpt import GPT
     from apex_tpu.optimizers.fused_adam import FusedAdam
     from apex_tpu.parallel import mesh as M
@@ -54,7 +55,7 @@ def _fused_tokens_per_sec(on_tpu, batch, seq, cfg):
     mesh = M.initialize_model_parallel(devices=jax.devices()[:1])
     model = GPT(cfg)
     params = model.init(jax.random.PRNGKey(0))
-    opt = FusedAdam(lr=1e-4, use_pallas=on_tpu)
+    opt = FusedAdam(lr=1e-4, use_pallas=on_tpu, master_dtype=master_dtype)
     opt_state = init_sharded_optimizer(opt, model, params, mesh)
     step = make_tp_dp_train_step(model, opt, mesh, donate=True)
     del params  # donated state owns the master copy
@@ -187,6 +188,69 @@ def _mha_latencies(on_tpu):
     return fused, unfused
 
 
+def _gpt1p3b_tokens_per_sec(on_tpu):
+    """1.3B single-chip config (VERDICT r2 #1): h2048 L24 H32, batch 8 x
+    seq 512, bf16 Adam state (p+m+v at 6 B/param fits one 16 GB chip),
+    'dots' selective remat, bf16 LM-head logits.  Swept in round 3
+    (docs/PERF.md 1.3B anatomy): 13.0k tok/s ~= 52% MFU on v5e."""
+    from apex_tpu.models.gpt import GPT2_1p3B, GPTConfig
+    if on_tpu:
+        batch, seq = 8, 512
+        cfg = GPTConfig(vocab_size=50304, seq_len=seq, dropout=0.0,
+                        dtype=jnp.bfloat16, logits_dtype=jnp.bfloat16,
+                        remat=True, remat_policy="dots",
+                        use_flash_attention=True, **GPT2_1p3B)
+    else:
+        batch, seq = 2, 64
+        cfg = GPTConfig(vocab_size=512, seq_len=seq, hidden=64,
+                        num_layers=2, num_heads=4, dropout=0.0,
+                        remat=True, remat_policy="dots")
+    return _fused_tokens_per_sec(on_tpu, batch, seq, cfg,
+                                 master_dtype=jnp.bfloat16)
+
+
+def _bert_seq_per_sec(on_tpu):
+    """BERT-Large MLM+NSP step with FusedLAMB (VERDICT r2 #5): flash
+    padding-masked attention + MXU segment-sum trust ratios.  Round-3
+    anatomy in docs/PERF.md: 73+ seq/s ~= 38% MFU at b8 x s512."""
+    from apex_tpu.models.bert import Bert, BertConfig
+    from apex_tpu.optimizers.fused_lamb import FusedLAMB
+    from apex_tpu.parallel import mesh as M
+    from apex_tpu.transformer.training import (
+        init_sharded_optimizer,
+        make_tp_dp_train_step,
+    )
+
+    batch, seq = (8, 512) if on_tpu else (2, 64)
+    M.destroy_model_parallel()
+    mesh = M.initialize_model_parallel(devices=jax.devices()[:1])
+    cfg = (BertConfig(seq_len=seq, dtype=jnp.bfloat16,
+                      use_flash_attention=True) if on_tpu else
+           BertConfig(seq_len=seq, hidden=128, num_layers=2, num_heads=4,
+                      dtype=jnp.bfloat16))
+    model = Bert(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+    opt = FusedLAMB(lr=1e-4, weight_decay=0.01, use_pallas=on_tpu)
+    opt_state = init_sharded_optimizer(opt, model, params, mesh)
+    del params
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (batch, seq), 0,
+                                cfg.vocab_size)
+    mlm_labels = jnp.roll(tokens, -1, axis=1)
+    loss_mask = jax.random.bernoulli(jax.random.PRNGKey(2), 0.15,
+                                     (batch, seq))
+    nsp = jax.random.randint(jax.random.PRNGKey(3), (batch,), 0, 2)
+
+    def loss_fn(p, t, l):
+        return model.loss(p, t, l, loss_mask, nsp_labels=nsp)
+
+    step = make_tp_dp_train_step(model, opt, mesh, loss_fn=loss_fn,
+                                 donate=True)
+    iters, warmup = (10, 2) if on_tpu else (2, 1)
+    dt = _time_steps(step, opt_state, tokens, mlm_labels, iters, warmup)
+    M.destroy_model_parallel()
+    return batch / dt
+
+
 def main():
     from apex_tpu.models.gpt import GPTConfig
 
@@ -225,6 +289,15 @@ def main():
         result["mha_unfused_fwd_bwd_ms"] = round(mha_unfused, 2)
     except Exception as e:
         result["mha_error"] = repr(e)[:120]
+    try:
+        result["gpt1p3b_tokens_per_sec_per_chip"] = round(
+            _gpt1p3b_tokens_per_sec(on_tpu), 1)
+    except Exception as e:
+        result["gpt1p3b_error"] = repr(e)[:120]
+    try:
+        result["bert_seq_per_sec"] = round(_bert_seq_per_sec(on_tpu), 1)
+    except Exception as e:
+        result["bert_error"] = repr(e)[:120]
     print(json.dumps(result))
 
 
