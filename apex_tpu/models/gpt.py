@@ -51,6 +51,15 @@ from apex_tpu.transformer.tensor_parallel.random import (
     model_parallel_fold_in,
 )
 
+# The checkpoint_name tags _block emits — the single source of truth
+# shared by the block (via _cn below) and remat_policy validation.
+REMAT_TAGS = frozenset({"qkv", "attn_ctx", "attn_out", "ffn1", "ffn_out"})
+
+
+def _cn(x, name):
+    assert name in REMAT_TAGS, name  # keep REMAT_TAGS in sync with _block
+    return checkpoint_name(x, name)
+
 
 @dataclasses.dataclass(frozen=True)
 class GPTConfig:
@@ -182,7 +191,7 @@ class GPT:
         """x: (S[, /tp], B, H) local.  Heads sharded over tp."""
         c = self.c
         qkv = qkv_mod.apply(block_params["qkv"], x)  # (S, B, 3H/tp)
-        qkv = checkpoint_name(qkv, "qkv")
+        qkv = _cn(qkv, "qkv")
         s, b, _ = qkv.shape
         nh_local = qkv.shape[-1] // (3 * c.head_dim)
         qkv = qkv.reshape(s, b, 3, nh_local, c.head_dim)
@@ -210,7 +219,7 @@ class GPT:
                              preferred_element_type=jnp.float32
                              ).astype(x.dtype)
         ctx = ctx.transpose(2, 0, 1, 3).reshape(s, b, -1)  # (S,B,H/tp)
-        ctx = checkpoint_name(ctx, "attn_ctx")
+        ctx = _cn(ctx, "attn_ctx")
         return proj_mod.apply(block_params["proj"], ctx)
 
     def _block(self, i, params, x, key):
@@ -221,14 +230,14 @@ class GPT:
             k1, k2, k3 = jax.random.split(key, 3)
         h = self._ln(bp["ln1"], x)
         attn = self._attention(bp, qkv_mod, proj_mod, h, k1)
-        attn = checkpoint_name(attn, "attn_out")
+        attn = _cn(attn, "attn_out")
         x = x + self._dropout(k2, attn)
         h = self._ln(bp["ln2"], x)
         m = fc1.apply(bp["fc1"], h)
-        m = checkpoint_name(m, "ffn1")
+        m = _cn(m, "ffn1")
         m = jax.nn.gelu(m, approximate=True)
         m = fc2.apply(bp["fc2"], m)
-        m = checkpoint_name(m, "ffn_out")
+        m = _cn(m, "ffn_out")
         x = x + self._dropout(k3, m)
         return x
 
@@ -257,6 +266,12 @@ class GPT:
                       and c.remat_policy.startswith("names:")):
                     names = tuple(
                         n for n in c.remat_policy[6:].split(",") if n)
+                    bad = [n for n in names if n not in REMAT_TAGS]
+                    if bad:
+                        raise ValueError(
+                            f"remat_policy names {bad} do not match any "
+                            f"checkpoint_name tag in _block; known tags: "
+                            f"{sorted(REMAT_TAGS)}")
                     pol = jax.checkpoint_policies.save_only_these_names(
                         *names)
                     blk = jax.checkpoint(blk, policy=pol)

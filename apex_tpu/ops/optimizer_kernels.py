@@ -758,12 +758,13 @@ def per_tensor_sumsq_shard(shard, spec, rank, padded_total,
 def expand_per_tensor_shard(values, seg):
     """Broadcast per-tensor scalars to ONE rank's shard elements —
     the shard-local counterpart of expand_per_tensor_aligned (padding
-    rows broadcast 1.0, harmless on zero-padded updates).  Prefer
-    lamb_phase2_seg, which folds the expansion into the update kernel
-    and never materializes the per-element vector."""
+    rows broadcast 0.0, matching the lamb_phase2_seg / one-hot kernel
+    convention for the padding segment).  Prefer lamb_phase2_seg, which
+    folds the expansion into the update kernel and never materializes
+    the per-element vector."""
     rows_shard = seg.shape[0]
     vals = jnp.concatenate(
-        [values.astype(jnp.float32), jnp.ones((1,), jnp.float32)])
+        [values.astype(jnp.float32), jnp.zeros((1,), jnp.float32)])
     per_row = vals[seg]                                    # (rows,)
     return jnp.broadcast_to(per_row[:, None],
                             (rows_shard, _LANES)).reshape(-1)

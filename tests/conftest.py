@@ -79,9 +79,20 @@ def pytest_collection_modifyitems(config, items):
             item.add_marker(pytest.mark.smoke)
     missing = SMOKE - matched
     # fail loudly when a rename/reparametrize silently drops a smoke
-    # entry — but only when the whole suite was collected (a -k or
+    # entry — but only when the whole suite was collected (a -k/-m or
     # path-restricted run legitimately sees a subset)
-    if missing and len(items) > 200:
+    unrestricted = (
+        not config.getoption("keyword", default="")
+        and not config.getoption("markexpr", default="")
+        and not config.getoption("ignore", default=None)
+        and not config.getoption("ignore_glob", default=None)
+        and not config.getoption("deselect", default=None)
+        and all(
+            os.path.realpath(a) in (
+                str(config.rootpath),
+                str(config.rootpath / "tests"))
+            for a in config.args))
+    if missing and unrestricted:
         raise pytest.UsageError(
             f"SMOKE entries match no collected test: {sorted(missing)}")
 
