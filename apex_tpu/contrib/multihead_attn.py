@@ -118,7 +118,9 @@ class SelfMultiheadAttn:
         while mask.ndim < 4:
             mask = mask[None]
         bias = jnp.where(mask, jnp.float32(-10000.0), jnp.float32(0.0))
-        return flash_attention(q, k, v, bias=bias, **common)
+        # the mask-derived bias is a constant: opt out of dbias work
+        return flash_attention(q, k, v, bias=bias, bias_grad=False,
+                               **common)
 
 
 class EncdecMultiheadAttn(SelfMultiheadAttn):

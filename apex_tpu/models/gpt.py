@@ -327,13 +327,17 @@ class GPTPipelined(GPT):
 
     def __init__(self, config: GPTConfig, num_microbatches: int,
                  pipeline_parallel_size: int,
-                 num_model_chunks: int = 1, remat_stage: bool = False):
+                 num_model_chunks: int = 1, remat_stage: bool = False,
+                 checkpoint_window=None):
         super().__init__(config)
         c = config
         self.num_microbatches = num_microbatches
         self.pp = pipeline_parallel_size
         self.chunks = num_model_chunks
         self.remat_stage = remat_stage
+        # 1F1B memory dial: jax.checkpoint window over pipeline clocks
+        # (schedules.spmd_pipeline docstring); pp is the 1F1B-bound pick
+        self.checkpoint_window = checkpoint_window
         assert c.num_layers % (self.pp * self.chunks) == 0, (
             "num_layers must divide pp * num_model_chunks")
         self.layers_per_stage = c.num_layers // (self.pp * self.chunks)
@@ -427,6 +431,7 @@ class GPTPipelined(GPT):
         total = spmd_pipeline(stage_fn, stage_blocks, h_mbs,
                               num_model_chunks=self.chunks,
                               remat_stage=self.remat_stage,
+                              checkpoint_window=self.checkpoint_window,
                               loss_fn=head_one, loss_args=lbl)
         return total / m
 

@@ -35,6 +35,9 @@ from apex_tpu.ops._common import dropout as _dense_dropout
 from apex_tpu.ops._common import pallas_interpret, use_pallas
 
 _NEG_INF = -1e30
+# fused single-pass backward cap: full-(sk, d) dk/dv scratch must fit
+# VMEM (tests monkeypatch this to force the two-kernel path at small sizes)
+_FUSED_BWD_CAP = 256 * 1024
 
 
 def _causal_dispatch(step_fn, j, t, bq, bk, causal):
@@ -59,7 +62,7 @@ def _causal_mask(st, j, t, bq, bk):
     return jnp.where(krow > qcol, _NEG_INF, st)
 
 
-def _mask_bias(st, j, t, bq, bk, causal_masked, has_bias, bias_ref,
+def _mask_bias(st, j, t, bq, bk, causal_masked, bias_kind, bias_ref,
                has_seg, qseg_ref, kseg_ref):
     """Apply (in order) additive bias, segment mask, causal mask to a
     TRANSPOSED (bk, bq) score block.
@@ -69,9 +72,15 @@ def _mask_bias(st, j, t, bq, bk, causal_masked, has_bias, bias_ref,
     x*scale + mask in-kernel) and the fmha varlen packing
     (fmha_api.cpp:18-160's cu_seqlens): segment ids are the TPU-native
     varlen — tokens attend only within equal ids, so packed sequences
-    and padding cost no cross-attention."""
-    if has_bias:
+    and padding cost no cross-attention.
+
+    bias_kind: "none" | "full" (a transposed (bk, bq) block of a
+    (.., sq, sk) bias) | "sk" (a (.., 1, sk) key-compact bias riding as
+    a (bk,) row — padding masks / ALiBi never expand to S² in HBM)."""
+    if bias_kind == "full":
         st = st + bias_ref[0, 0]                        # (bk, bq)
+    elif bias_kind == "sk":
+        st = st + bias_ref[0, 0, 0].reshape(bk, 1)      # k-varying row
     if has_seg:
         qs = qseg_ref[0, j]                             # (bq,) lanes
         ks = kseg_ref[0, t].reshape(bk, 1)              # (bk, 1) sublanes
@@ -81,22 +90,42 @@ def _mask_bias(st, j, t, bq, bk, causal_masked, has_bias, bias_ref,
     return st
 
 
-def _extras_arrays(b, h, sq, sk, nq, bq, nk, bk, bias, q_seg, kv_seg):
+def _bias_kind(bias, sk):
+    """Static bias classification.  "sk" = key-compact (.., 1, sk):
+    rides compact through the kernels (no S² expansion in HBM — the
+    padding-mask / ALiBi case).  "none" also covers query-compact
+    (.., *, 1) biases: a per-query score constant cancels exactly in
+    softmax (finite values — whole-row masking must use segment ids),
+    so the kernels skip it entirely instead of expanding it to S².
+    Everything else is "full" (.., sq, sk)."""
+    if bias is None:
+        return "none"
+    if bias.shape[3] == 1:
+        return "none"
+    if bias.shape[2] == 1 and bias.shape[3] == sk:
+        return "sk"
+    return "full"
+
+
+def _extras_arrays(b, h, sq, sk, nq, bq, nk, bk, bias, q_seg, kv_seg,
+                   bias_kind="none"):
     """Host-side packing of the optional bias / segment-id operands.
 
-    bias: broadcastable (nb in {1,b}, nh in {1,h}, sq, sk) — passed to
-    the kernels TRANSPOSED as (nb, nh, sk, sq) so score blocks need no
-    per-step transpose.  Segment ids: (b, s) int32, reshaped to
-    (b, n_blocks, block) whole-row-resident blocks.  Absent operands
-    ride as (1,1,1,1)/(1,1,1) dummies (static has_* flags gate every
-    kernel read)."""
-    if bias is not None:
+    bias: broadcastable (nb in {1,b}, nh in {1,h}, sq, sk) — "full"
+    biases pass to the kernels TRANSPOSED as (nb, nh, sk, sq) so score
+    blocks need no per-step transpose; "sk" key-compact biases stay
+    (nb, nh, 1, sk) — never expanded.  Segment ids: (b, s) int32,
+    reshaped to (b, n_blocks, block) whole-row-resident blocks.  Absent
+    operands ride as (1,1,1,1)/(1,1,1) dummies (static kind flags gate
+    every kernel read)."""
+    if bias_kind == "sk":
         nb, nh = bias.shape[0], bias.shape[1]
-        # broadcast-1 sq/sk dims expand HERE (inside fwd/bwd impls, not
+        bias_t = bias.astype(jnp.float32)               # (nb, nh, 1, sk)
+    elif bias_kind == "full":
+        nb, nh = bias.shape[0], bias.shape[1]
+        # broadcast-1 sq dims expand HERE (inside fwd/bwd impls, not
         # before the custom_vjp) so the VJP residuals keep the caller's
-        # compact bias; batch/head broadcasting stays in the index map.
-        # NOTE a (.., 1, sk) pad bias still expands to sq*sk transiently
-        # — prefer segment_ids for pure padding (no S^2 anything)
+        # compact bias; batch/head broadcasting stays in the index map
         bias_t = jnp.broadcast_to(
             jnp.swapaxes(bias.astype(jnp.float32), 2, 3),
             (nb, nh, sk, sq))
@@ -112,16 +141,22 @@ def _extras_arrays(b, h, sq, sk, nq, bq, nk, bk, bias, q_seg, kv_seg):
     return bias_t, qs, ks
 
 
-def _extras_specs(h, nq, bq, nk, bk, has_bias, nb, nh, has_seg, *,
+def _extras_specs(h, nq, bq, nk, bk, bias_kind, nb, nh, has_seg, *,
                   jt_from_args):
     """BlockSpecs for (bias_t, q_seg, kv_seg).  `jt_from_args` maps the
     grid args after i to (j, t) — grids differ in block order."""
-    if has_bias:
+    if bias_kind == "full":
         def bias_idx(i, *rest):
             j, t = jt_from_args(*rest)
             return (i // h if nb > 1 else 0,
                     i % h if nh > 1 else 0, t, j)
         bspec = pl.BlockSpec((1, 1, bk, bq), bias_idx)
+    elif bias_kind == "sk":
+        def bias_idx(i, *rest):
+            j, t = jt_from_args(*rest)
+            return (i // h if nb > 1 else 0,
+                    i % h if nh > 1 else 0, 0, t)
+        bspec = pl.BlockSpec((1, 1, 1, bk), bias_idx)
     else:
         bspec = pl.BlockSpec((1, 1, 1, 1), lambda i, *_: (0, 0, 0, 0))
     if has_seg:
@@ -206,7 +241,7 @@ def attention_reference(q, k, v, *, causal=False, softmax_scale=None,
 def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, qseg_ref, kseg_ref,
                 seed_ref, o_ref, lse_ref,
                 m_scr, l_scr, acc_scr, *, scale, causal, bq, bk, nk,
-                dropout_rate, has_bias, has_seg):
+                dropout_rate, bias_kind, has_seg):
     """Scores run TRANSPOSED (bk, bq): the softmax statistics (m, l,
     lse) are then (1, bq) lane-major rows — fully-packed vregs instead
     of 1/128-occupied columns, and the lse/delta HBM arrays are
@@ -228,7 +263,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, qseg_ref, kseg_ref,
         st = jax.lax.dot_general(k_ref[0], q_ref[0],
                                  (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32) * scale
-        st = _mask_bias(st, j, t, bq, bk, masked, has_bias, bias_ref,
+        st = _mask_bias(st, j, t, bq, bk, masked, bias_kind, bias_ref,
                         has_seg, qseg_ref, kseg_ref)
         m_prev = m_scr[...]                                     # (1, bq)
         m_new = jnp.maximum(m_prev, jnp.max(st, axis=0, keepdims=True))
@@ -264,8 +299,12 @@ def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, qseg_ref, kseg_ref,
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                    bias_ref, qseg_ref, kseg_ref,
-                   seed_ref, dq_ref, dq_scr, *, scale, causal, bq, bk, nk,
-                   dropout_rate, has_bias, has_seg):
+                   seed_ref, dq_ref, *rest, scale, causal, bq, bk, nk,
+                   dropout_rate, bias_kind, has_seg, want_dbias=False):
+    if want_dbias:          # "full"-bias grad: ds IS the dbias block
+        db_ref, dq_scr = rest
+    else:
+        db_ref, (dq_scr,) = None, rest
     i = pl.program_id(0)
     j = pl.program_id(1)
     t = pl.program_id(2)
@@ -274,12 +313,17 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     def _init():
         dq_scr[...] = jnp.zeros_like(dq_scr)
 
+    if want_dbias:
+        # causal-skipped blocks never run _step: zero first, overwrite
+        # in-step (same VMEM-resident block, ordered within this step)
+        db_ref[0] = jnp.zeros_like(db_ref[0])
+
     def _step(masked):
         # transposed scores (bk, bq): lse/delta are (1, bq) lane rows
         st = jax.lax.dot_general(k_ref[0], q_ref[0],
                                  (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32) * scale
-        st = _mask_bias(st, j, t, bq, bk, masked, has_bias, bias_ref,
+        st = _mask_bias(st, j, t, bq, bk, masked, bias_kind, bias_ref,
                         has_seg, qseg_ref, kseg_ref)
         p = jnp.exp(st - lse_ref[0, j])                         # (bk, bq)
         dp = jax.lax.dot_general(v_ref[0], do_ref[0],
@@ -289,6 +333,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             keep = _dropout_keep(seed_ref, i, j, t, (bk, bq), dropout_rate)
             dp = jnp.where(keep, dp, 0.0) * (1.0 / (1.0 - dropout_rate))
         ds = p * (dp - delta_ref[0, j])                         # (bk, bq)
+        if want_dbias:
+            db_ref[0] = ds
         # (bk, bq)^T-contract (bk, d) -> (bq, d)
         dq_scr[...] += scale * jax.lax.dot_general(
             ds.astype(k_ref.dtype), k_ref[0], (((0,), (0,)), ((), ())),
@@ -303,8 +349,14 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                     bias_ref, qseg_ref, kseg_ref,
-                    seed_ref, dk_ref, dv_ref, dk_scr, dv_scr, *, scale,
-                    causal, bq, bk, nq, dropout_rate, has_bias, has_seg):
+                    seed_ref, dk_ref, dv_ref, *rest, scale,
+                    causal, bq, bk, nq, dropout_rate, bias_kind, has_seg,
+                    want_dbias=False):
+    if want_dbias:          # "sk"-bias grad: q-summed ds rows
+        db_ref, dk_scr, dv_scr, dbr_scr = rest
+    else:
+        db_ref = dbr_scr = None
+        dk_scr, dv_scr = rest
     i = pl.program_id(0)
     t = pl.program_id(1)  # k block
     j = pl.program_id(2)  # q block (sequential inner)
@@ -313,13 +365,15 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     def _init():
         dk_scr[...] = jnp.zeros_like(dk_scr)
         dv_scr[...] = jnp.zeros_like(dv_scr)
+        if want_dbias:
+            dbr_scr[...] = jnp.zeros_like(dbr_scr)
 
     def _step(masked):
         # transposed scores (bk, bq): lse/delta are (1, bq) lane rows
         st = jax.lax.dot_general(k_ref[0], q_ref[0],
                                  (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32) * scale
-        st = _mask_bias(st, j, t, bq, bk, masked, has_bias, bias_ref,
+        st = _mask_bias(st, j, t, bq, bk, masked, bias_kind, bias_ref,
                         has_seg, qseg_ref, kseg_ref)
         p = jnp.exp(st - lse_ref[0, j])                 # (bk, bq)
         if dropout_rate > 0.0:
@@ -337,6 +391,13 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         if dropout_rate > 0.0:
             dp = jnp.where(keep, dp, 0.0) * inv
         ds = p * (dp - delta_ref[0, j])                 # (bk, bq)
+        if want_dbias:
+            # q-sum of ds as a LANE-major (1, bk) row via the MXU
+            # (ones-contract) — no sublane→lane relayout
+            dbr_scr[...] += jax.lax.dot_general(
+                jnp.ones((1, bq), jnp.float32), ds,
+                (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)     # (1, bk)
         dk_scr[...] += scale * jax.lax.dot_general(
             ds.astype(q_ref.dtype), q_ref[0], (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)         # (bk, d)
@@ -347,13 +408,18 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     def _epilogue():
         dk_ref[0] = dk_scr[...].astype(dk_ref.dtype)
         dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
+        if want_dbias:
+            # db rides as (1, nk, bk) whole-head rows (≡ the lse layout
+            # trick): write k-block row t
+            db_ref[0, t] = dbr_scr[...].reshape(bk)
 
 
 def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                       bias_ref, qseg_ref, kseg_ref,
-                      seed_ref, dq_ref, dk_ref, dv_ref,
-                      dq_scr, dk_scr, dv_scr, *, scale, causal, bq, bk,
-                      nq, nk, dropout_rate, has_bias, has_seg):
+                      seed_ref, dq_ref, dk_ref, dv_ref, *rest,
+                      scale, causal, bq, bk,
+                      nq, nk, dropout_rate, bias_kind, has_seg,
+                      want_dbias=False):
     """Single-pass backward: dq, dk, dv from ONE score/exp recompute.
 
     The two-kernel split recomputes st/p twice (7 matmuls + 2 exp
@@ -362,6 +428,11 @@ def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     usual pattern); dk/dv accumulate across the OUTER q loop in a
     full-(sk, d) VMEM scratch, which caps this path at moderate sk —
     _bwd_impl falls back to the two-kernel path beyond that."""
+    if want_dbias:          # "full"-bias grad: ds IS the dbias block
+        db_ref, dq_scr, dk_scr, dv_scr = rest
+    else:
+        db_ref = None
+        dq_scr, dk_scr, dv_scr = rest
     i = pl.program_id(0)
     j = pl.program_id(1)  # q block (outer)
     t = pl.program_id(2)  # k block (inner)
@@ -369,6 +440,9 @@ def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     @pl.when(t == 0)
     def _init_dq():
         dq_scr[...] = jnp.zeros_like(dq_scr)
+
+    if want_dbias:
+        db_ref[0] = jnp.zeros_like(db_ref[0])
 
     @pl.when((j == 0) & (t == 0))
     def _init_dkv():
@@ -380,7 +454,7 @@ def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         st = jax.lax.dot_general(k_ref[0], q_ref[0],
                                  (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32) * scale
-        st = _mask_bias(st, j, t, bq, bk, masked, has_bias, bias_ref,
+        st = _mask_bias(st, j, t, bq, bk, masked, bias_kind, bias_ref,
                         has_seg, qseg_ref, kseg_ref)
         p = jnp.exp(st - lse_ref[0, j])                 # (bk, bq)
         dp = jax.lax.dot_general(v_ref[0], do_ref[0],
@@ -397,6 +471,8 @@ def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             p_v.astype(do_ref.dtype), do_ref[0], (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)         # (bk, d)
         ds = p * (dp - delta_ref[0, j])                 # (bk, bq)
+        if want_dbias:
+            db_ref[0] = ds
         dk_scr[rows] += scale * jax.lax.dot_general(
             ds.astype(q_ref.dtype), q_ref[0], (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)         # (bk, d)
@@ -426,18 +502,19 @@ def _pick_block(seq, cap=512):
     return None
 
 
-def _resolve_blocks(sq, sk, block_q, block_k, has_bias=False):
+def _resolve_blocks(sq, sk, block_q, block_k, full_bias=False):
     """Default blocks, swept on v5e (docs/PERF.md): single block per
     axis when the sequence fits (<=1024 — grid overhead dominates the
     extra causal-mask work), else (512, 1024) to cap the fp32 score
     tile at 2 MB of VMEM while keeping k-side matmuls wide.  Explicit
-    blocks must divide the sequence.  A fused bias adds a same-size
-    fp32 block, so the q block is halved to stay inside VMEM."""
+    blocks must divide the sequence.  A fused FULL bias adds a
+    same-size fp32 block, so the q block is halved to stay inside VMEM
+    (a key-compact "sk" bias is only a (bk,) row — no halving)."""
     if block_q is not None and sq % block_q:
         raise ValueError(f"block_q={block_q} does not divide sq={sq}")
     if block_k is not None and sk % block_k:
         raise ValueError(f"block_k={block_k} does not divide sk={sk}")
-    q_cap = 1024 if (sq <= 1024 and not has_bias) else 512
+    q_cap = 1024 if (sq <= 1024 and not full_bias) else 512
     bq = block_q or _pick_block(sq, cap=q_cap)
     bk = block_k or _pick_block(sk, cap=1024)
     return bq, bk
@@ -461,25 +538,26 @@ def _fwd_impl(q, k, v, scale, causal, dropout_rate=0.0, seed=None,
               kv_seg=None):
     b, h, sq, d = q.shape
     sk = k.shape[2]
+    bias_kind = _bias_kind(bias, sk)
     bq, bk = _resolve_blocks(sq, sk, block_q, block_k,
-                              has_bias=bias is not None)
+                              full_bias=bias_kind == "full")
     qf, kf, vf = _flatten_bh(q), _flatten_bh(k), _flatten_bh(v)
     bh = b * h
     nq, nk = sq // bq, sk // bk
     if seed is None:
         seed = jnp.zeros((1, 1), jnp.int32)
-    has_bias, has_seg = bias is not None, q_seg is not None
-    nb = bias.shape[0] if has_bias else 1
-    nh = bias.shape[1] if has_bias else 1
+    has_seg = q_seg is not None
+    nb = bias.shape[0] if bias is not None else 1
+    nh = bias.shape[1] if bias is not None else 1
     bias_t, qs, ks = _extras_arrays(b, h, sq, sk, nq, bq, nk, bk,
-                                    bias, q_seg, kv_seg)
+                                    bias, q_seg, kv_seg, bias_kind)
     bspec, qsspec, ksspec = _extras_specs(
-        h, nq, bq, nk, bk, has_bias, nb, nh, has_seg,
+        h, nq, bq, nk, bk, bias_kind, nb, nh, has_seg,
         jt_from_args=lambda j, t: (j, t))
     o, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, scale=scale, causal=causal, bq=bq,
                           bk=bk, nk=nk, dropout_rate=dropout_rate,
-                          has_bias=has_bias, has_seg=has_seg),
+                          bias_kind=bias_kind, has_seg=has_seg),
         grid=(bh, nq, nk),
         in_specs=[
             pl.BlockSpec((1, bq, d), lambda i, j, t: (i, j, 0)),
@@ -524,25 +602,27 @@ def _head_row_spec(nq, bq):
 
 def _bwd_impl(q, k, v, o, lse, do, scale, causal, dropout_rate=0.0,
               seed=None, block_q=None, block_k=None, bias=None,
-              q_seg=None, kv_seg=None):
+              q_seg=None, kv_seg=None, want_dbias=False):
+    """Returns (dq, dk, dv, dbias) — dbias is None unless want_dbias."""
     b, h, sq, d = q.shape
     sk = k.shape[2]
+    bias_kind = _bias_kind(bias, sk)
     bq, bk = _resolve_blocks(sq, sk, block_q, block_k,
-                              has_bias=bias is not None)
+                              full_bias=bias_kind == "full")
     nq, nk = sq // bq, sk // bk
     bh = b * h
     if seed is None:
         seed = jnp.zeros((1, 1), jnp.int32)
-    has_bias, has_seg = bias is not None, q_seg is not None
-    nb = bias.shape[0] if has_bias else 1
-    nh = bias.shape[1] if has_bias else 1
+    has_seg = q_seg is not None
+    nb = bias.shape[0] if bias is not None else 1
+    nh = bias.shape[1] if bias is not None else 1
     bias_t, qsegs, ksegs = _extras_arrays(b, h, sq, sk, nq, bq, nk, bk,
-                                          bias, q_seg, kv_seg)
+                                          bias, q_seg, kv_seg, bias_kind)
     bspec, qsspec, ksspec = _extras_specs(
-        h, nq, bq, nk, bk, has_bias, nb, nh, has_seg,
+        h, nq, bq, nk, bk, bias_kind, nb, nh, has_seg,
         jt_from_args=lambda j, t: (j, t))
     static = dict(scale=scale, causal=causal, bq=bq, bk=bk,
-                  dropout_rate=dropout_rate, has_bias=has_bias,
+                  dropout_rate=dropout_rate, bias_kind=bias_kind,
                   has_seg=has_seg)
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
                     axis=-1)  # (b,h,sq)
@@ -554,18 +634,41 @@ def _bwd_impl(q, k, v, o, lse, do, scale, causal, dropout_rate=0.0,
     r1 = _head_row_spec(nq, bq)
     sspec1 = pl.BlockSpec((1, 1), lambda i, j, t: (0, 0))
 
+    def _reduce_db(db_full):
+        """(b, h, ...) per-head dbias partials → the caller's broadcast
+        shape (nb, nh, ...)."""
+        if nb == 1:
+            db_full = jnp.sum(db_full, axis=0, keepdims=True)
+        if nh == 1:
+            db_full = jnp.sum(db_full, axis=1, keepdims=True)
+        return db_full
+
+    # dbias("full") comes from the fused/dq kernels (ds written per
+    # (j, t) block); dbias("sk") needs the dkv grid (q-sum accumulates
+    # over the inner j axis), so it forces the two-kernel path
+    dbias_full = want_dbias and bias_kind == "full"
+    dbias_sk = want_dbias and bias_kind == "sk"
+
     # single-pass fused backward while the full-(sk, d) dk/dv scratch
     # fits VMEM comfortably; two-kernel fallback for long context
-    if sk * d <= 256 * 1024:
-        dq, dk, dv = pl.pallas_call(
-            functools.partial(_bwd_fused_kernel, nq=nq, nk=nk, **static),
+    if sk * d <= _FUSED_BWD_CAP and not dbias_sk:
+        out_specs = [qspec, kspec, kspec]
+        out_shape = [jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+                     jax.ShapeDtypeStruct((bh, sk, d), k.dtype),
+                     jax.ShapeDtypeStruct((bh, sk, d), v.dtype)]
+        if dbias_full:
+            out_specs.append(pl.BlockSpec((1, bk, bq),
+                                          lambda i, j, t: (i, t, j)))
+            out_shape.append(
+                jax.ShapeDtypeStruct((bh, sk, sq), jnp.float32))
+        outs = pl.pallas_call(
+            functools.partial(_bwd_fused_kernel, nq=nq, nk=nk,
+                              want_dbias=dbias_full, **static),
             grid=(bh, nq, nk),
             in_specs=[qspec, kspec, kspec, qspec, r1, r1,
                       bspec, qsspec, ksspec, sspec1],
-            out_specs=[qspec, kspec, kspec],
-            out_shape=[jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
-                       jax.ShapeDtypeStruct((bh, sk, d), k.dtype),
-                       jax.ShapeDtypeStruct((bh, sk, d), v.dtype)],
+            out_specs=out_specs,
+            out_shape=out_shape,
             scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32),
                             pltpu.VMEM((sk, d), jnp.float32),
                             pltpu.VMEM((sk, d), jnp.float32)],
@@ -575,73 +678,117 @@ def _bwd_impl(q, k, v, o, lse, do, scale, causal, dropout_rate=0.0,
                 dimension_semantics=("parallel", "arbitrary", "arbitrary")),
             interpret=pallas_interpret(),
         )(*args)
+        dq, dk, dv = outs[:3]
+        dbias = None
+        if dbias_full:
+            db = _reduce_db(outs[3].reshape(b, h, sk, sq))
+            dbias = jnp.swapaxes(db, 2, 3)
         return (dq.reshape(q.shape), dk.reshape(k.shape),
-                dv.reshape(v.shape))
+                dv.reshape(v.shape), dbias)
 
-    dq = pl.pallas_call(
-        functools.partial(_bwd_dq_kernel, nk=nk, **static),
+    dq_specs = [qspec]
+    dq_shape = [jax.ShapeDtypeStruct((bh, sq, d), q.dtype)]
+    if dbias_full:
+        dq_specs.append(pl.BlockSpec((1, bk, bq),
+                                     lambda i, j, t: (i, t, j)))
+        dq_shape.append(jax.ShapeDtypeStruct((bh, sk, sq), jnp.float32))
+    dq_out = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, nk=nk, want_dbias=dbias_full,
+                          **static),
         grid=(bh, nq, nk),
         in_specs=[qspec, kspec, kspec, qspec, r1, r1,
                   bspec, qsspec, ksspec, sspec1],
-        out_specs=qspec,
-        out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+        out_specs=dq_specs if dbias_full else dq_specs[0],
+        out_shape=dq_shape if dbias_full else dq_shape[0],
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
         compiler_params=_compiler_params(3),
         interpret=pallas_interpret(),
     )(*args)
+    dbias = None
+    if dbias_full:
+        dq, db_t = dq_out
+        dbias = jnp.swapaxes(_reduce_db(db_t.reshape(b, h, sk, sq)), 2, 3)
+    else:
+        dq = dq_out
     # dkv grid: k blocks outer, q blocks inner-sequential
     qspec2 = pl.BlockSpec((1, bq, d), lambda i, t, j: (i, j, 0))
     kspec2 = pl.BlockSpec((1, bk, d), lambda i, t, j: (i, t, 0))
     r2 = _head_row_spec(nq, bq)
     sspec2 = pl.BlockSpec((1, 1), lambda i, t, j: (0, 0))
     bspec2, qsspec2, ksspec2 = _extras_specs(
-        h, nq, bq, nk, bk, has_bias, nb, nh, has_seg,
+        h, nq, bq, nk, bk, bias_kind, nb, nh, has_seg,
         jt_from_args=lambda t, j: (j, t))
-    dk, dv = pl.pallas_call(
-        functools.partial(_bwd_dkv_kernel, nq=nq, **static),
+    dkv_specs = [kspec2, kspec2]
+    dkv_shape = [jax.ShapeDtypeStruct((bh, sk, d), k.dtype),
+                 jax.ShapeDtypeStruct((bh, sk, d), v.dtype)]
+    dkv_scratch = [pltpu.VMEM((bk, d), jnp.float32),
+                   pltpu.VMEM((bk, d), jnp.float32)]
+    if dbias_sk:
+        # db rides as (bh, nk, bk) whole-head rows (the lse layout);
+        # shared across both block axes → t must not Megacore-split
+        dkv_specs.append(pl.BlockSpec((1, nk, bk),
+                                      lambda i, t, j: (i, 0, 0)))
+        dkv_shape.append(jax.ShapeDtypeStruct((bh, nk, bk), jnp.float32))
+        dkv_scratch.append(pltpu.VMEM((1, bk), jnp.float32))
+        dkv_params = pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary", "arbitrary"))
+    else:
+        dkv_params = _compiler_params(3)
+    outs = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, nq=nq, want_dbias=dbias_sk,
+                          **static),
         grid=(bh, nk, nq),
         in_specs=[qspec2, kspec2, kspec2, qspec2, r2, r2,
                   bspec2, qsspec2, ksspec2, sspec2],
-        out_specs=[kspec2, kspec2],
-        out_shape=[jax.ShapeDtypeStruct((bh, sk, d), k.dtype),
-                   jax.ShapeDtypeStruct((bh, sk, d), v.dtype)],
-        scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
-                        pltpu.VMEM((bk, d), jnp.float32)],
-        compiler_params=_compiler_params(3),
+        out_specs=dkv_specs,
+        out_shape=dkv_shape,
+        scratch_shapes=dkv_scratch,
+        compiler_params=dkv_params,
         interpret=pallas_interpret(),
     )(*args)
-    return (dq.reshape(q.shape), dk.reshape(k.shape), dv.reshape(v.shape))
+    dk, dv = outs[:2]
+    if dbias_sk:
+        db = _reduce_db(outs[2].reshape(b, h, sk))       # (nb, nh, sk)
+        dbias = db[:, :, None, :]                        # (nb, nh, 1, sk)
+    return (dq.reshape(q.shape), dk.reshape(k.shape),
+            dv.reshape(v.shape), dbias)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(6, 7, 8, 9, 10))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(6, 7, 8, 9, 10, 11))
 def _flash(q, k, v, bias, q_seg, kv_seg, scale, causal, dropout_rate,
-           block_q, block_k, seed):
+           block_q, block_k, bias_grad, seed):
     o, _ = _fwd_impl(q, k, v, scale, causal, dropout_rate, seed,
                      block_q, block_k, bias, q_seg, kv_seg)
     return o
 
 
 def _flash_fwd(q, k, v, bias, q_seg, kv_seg, scale, causal, dropout_rate,
-               block_q, block_k, seed):
+               block_q, block_k, bias_grad, seed):
     o, lse = _fwd_impl(q, k, v, scale, causal, dropout_rate, seed,
                        block_q, block_k, bias, q_seg, kv_seg)
     return o, (q, k, v, bias, q_seg, kv_seg, o, lse, seed)
 
 
-def _flash_bwd(scale, causal, dropout_rate, block_q, block_k, res, do):
+def _flash_bwd(scale, causal, dropout_rate, block_q, block_k, bias_grad,
+               res, do):
     q, k, v, bias, q_seg, kv_seg, o, lse, seed = res
-    dq, dk, dv = _bwd_impl(q, k, v, o, lse, do, scale, causal,
-                           dropout_rate, seed, block_q, block_k,
-                           bias, q_seg, kv_seg)
+    # a key-broadcast (.., *, 1) bias adds a per-query constant to the
+    # scores — softmax cancels it, so its gradient is EXACTLY zero (no
+    # kernel work); bias_grad=False opts constant biases (padding
+    # masks, fixed ALiBi) out of the dbias computation entirely
+    want_dbias = (bias_grad and bias is not None and bias.shape[3] != 1)
+    dq, dk, dv, dbias = _bwd_impl(q, k, v, o, lse, do, scale, causal,
+                                  dropout_rate, seed, block_q, block_k,
+                                  bias, q_seg, kv_seg,
+                                  want_dbias=want_dbias)
     import numpy as _np
 
     def _int_zero(x):
         return (None if x is None
                 else _np.zeros(x.shape, dtype=jax.dtypes.float0))
-    # bias is treated as a CONSTANT (padding masks, fixed position
-    # biases): its cotangent is zero by contract — see flash_attention's
-    # docstring
-    dbias = None if bias is None else jnp.zeros_like(bias)
+    if bias is not None:
+        dbias = (dbias.astype(bias.dtype) if want_dbias
+                 else jnp.zeros_like(bias))
     return (dq, dk, dv, dbias, _int_zero(q_seg), _int_zero(kv_seg),
             _int_zero(seed))
 
@@ -661,6 +808,7 @@ def flash_attention(q, k, v, *, causal: bool = False,
                     dropout_key=None,
                     block_q: Optional[int] = None,
                     block_k: Optional[int] = None,
+                    bias_grad: bool = True,
                     use_pallas_override: Optional[bool] = None):
     """Flash attention over (batch, heads, seq, head_dim).
 
@@ -672,13 +820,24 @@ def flash_attention(q, k, v, *, causal: bool = False,
     fmha/src/fmha/softmax.h) — no sq x sk mask ever reaches HBM, so
     dropout works at any sequence length.
 
-    bias: additive fp score bias, shape (b|1, h|1, sq, sk), fused into
-    the kernel (≡ the additive-mask softmax in
-    apex/contrib/csrc/multihead_attn/softmax.cuh:27-200).  It is
-    treated as a CONSTANT — its cotangent is defined as zero — which
-    covers padding masks, ALiBi slopes, and fixed relative-position
-    biases; a *trainable* bias must go through the dense reference
-    path.
+    bias: additive fp score bias, shape (b|1, h|1, sq|1, sk), fused
+    into the kernel (≡ the additive-mask softmax in
+    apex/contrib/csrc/multihead_attn/softmax.cuh:27-200).  A
+    key-compact (.., 1, sk) bias — the padding-mask / ALiBi shape —
+    rides compact through the kernels (never expanded to sq × sk in
+    HBM).  TRAINABLE biases are first-class (≡ the
+    self_multihead_attn_bias CUDA variants): the backward emits the
+    real dbias, reduced over broadcast dims — full (sq, sk) biases
+    from per-block ds writes, key-compact ones from an in-kernel
+    q-sum.  COST NOTE: a differentiated call with a full (sq, sk)
+    bias materializes a per-(b, h) fp32 dbias partial (b·h·sq·sk
+    bytes ×4 transient) before the broadcast reduction — pass
+    bias_grad=False for constant biases (padding masks, fixed slopes)
+    to skip all dbias work, as the in-repo mask paths do.  A
+    (.., *, 1) query-compact bias is a per-query score constant:
+    softmax cancels it exactly (finite values; whole-row masking must
+    use segment ids), so it is skipped in the kernels and its gradient
+    is exactly zero.
 
     segment_ids: (b, s) int — tokens attend only where ids are equal;
     this is the TPU-native form of the reference fmha's cu_seqlens
@@ -730,12 +889,12 @@ def flash_attention(q, k, v, *, causal: bool = False,
             seed = jnp.zeros((1, 1), jnp.int32)
         return _flash(q, k, v, bias, q_segment_ids, kv_segment_ids,
                       scale, causal, float(dropout_rate),
-                      block_q, block_k, seed)
-    # stop_gradient keeps the zero-dbias contract identical to the
-    # kernel path — a trainable bias must call attention_reference
-    # directly, on every backend
+                      block_q, block_k, bool(bias_grad), seed)
+    # fallback keeps the same dbias semantics: AD through the dense
+    # path yields the (broadcast-reduced) dbias when bias_grad, and a
+    # stop_gradient reproduces the constant-bias contract otherwise
     return attention_reference(q, k, v, causal=causal, softmax_scale=scale,
-                               bias=(None if bias is None
+                               bias=(bias if bias is None or bias_grad
                                      else lax.stop_gradient(bias)),
                                q_segment_ids=q_segment_ids,
                                kv_segment_ids=kv_segment_ids,

@@ -26,11 +26,29 @@ from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from apex_tpu.ops import optimizer_kernels as K
 from apex_tpu.optimizers import flat as F
 from apex_tpu.parallel.mesh import DP_AXIS
+
+
+def _bucket_ranges(sizes, n_buckets):
+    """Contiguous leaf ranges with ~equal element counts — the bucket
+    boundaries for backward-overlapped grad sync (≡ the reference's
+    fixed-size grad buckets, distributed_fused_adam.py:302-447)."""
+    n_buckets = max(1, min(n_buckets, len(sizes)))
+    total = sum(sizes)
+    ranges, start, acc = [], 0, 0
+    for i, s in enumerate(sizes):
+        acc += s
+        if (len(ranges) < n_buckets - 1
+                and acc * n_buckets >= total * (len(ranges) + 1)):
+            ranges.append((start, i + 1))
+            start = i + 1
+    ranges.append((start, len(sizes)))
+    return [r for r in ranges if r[0] < r[1]]
 
 
 class DistributedFusedAdamState(NamedTuple):
@@ -98,7 +116,17 @@ class DistributedFusedAdam(_ShardedFlat):
                  betas=(0.9, 0.999), eps=1e-8, adam_w_mode=True,
                  weight_decay=0.0, axis_name: str = DP_AXIS,
                  grad_sync_dtype=jnp.float32, param_sync_dtype=None,
+                 n_buckets: int = 1,
                  use_pallas: Optional[bool] = None):
+        """n_buckets > 1 splits the flat buffer into contiguous
+        leaf-group buckets, each reduce-scattered INDEPENDENTLY: a
+        bucket's collective depends only on its own leaves' grads, so
+        XLA's scheduler can start it while backward still computes the
+        other buckets (≡ the reference's per-bucket grad hooks,
+        distributed_fused_adam.py:652-712 + bucket sync 1274-1571 —
+        one fused psum_scatter cannot start before the LAST grad
+        exists).  The shard layout becomes bucket-major; init/step/
+        gather and the checkpoint fingerprint all agree on it."""
         self.num_shards = num_shards
         self.lr = lr
         self.bias_correction = bias_correction
@@ -109,21 +137,70 @@ class DistributedFusedAdam(_ShardedFlat):
         self.axis_name = axis_name
         self.grad_sync_dtype = grad_sync_dtype
         self.param_sync_dtype = param_sync_dtype
+        self.n_buckets = n_buckets
         self.use_pallas = use_pallas
         self.spec: Optional[F.FlatSpec] = None
         self.padded_total = None
 
+    def _bucket_flats(self, tree, dtype):
+        leaves = jax.tree_util.tree_leaves(tree)
+        return [F.flatten(leaves[a:b], dtype,
+                          pad_to=self.num_shards * K.FLAT_TILE)
+                for a, b in self._ranges]
+
     def init(self, params) -> DistributedFusedAdamState:
         self._make_spec(params)
-        flat = self._flatten(params)
-        self.padded_total = flat.shape[0]
-        shard_size = self.padded_total // self.num_shards
+        leaves = jax.tree_util.tree_leaves(params)
+        sizes = [int(np.prod(l.shape)) if l.shape else 1 for l in leaves]
+        self._ranges = _bucket_ranges(sizes, self.n_buckets)
+        self.bucket_specs = [F.make_spec(leaves[a:b])
+                             for a, b in self._ranges]
+        flats = self._bucket_flats(params, jnp.float32)
+        self._bucket_padded = [f.shape[0] for f in flats]
+        self.padded_total = sum(self._bucket_padded)
         rank = lax.axis_index(self.axis_name)
-        shard = lax.dynamic_slice(flat, (rank * shard_size,), (shard_size,))
+        shard = jnp.concatenate([
+            lax.dynamic_slice(f, (rank * (n // self.num_shards),),
+                              (n // self.num_shards,))
+            for f, n in zip(flats, self._bucket_padded)])
         zeros = jnp.zeros_like(shard)
         return DistributedFusedAdamState(
             step=jnp.zeros((), jnp.int32), params_shard=shard,
             exp_avg=zeros, exp_avg_sq=zeros)
+
+    def _gather_full(self, shard):
+        """Bucket-aware param all-gather (one gather per bucket; the
+        single-bucket case is the base layout exactly)."""
+        sync_dt = self.param_sync_dtype
+        if sync_dt is None:
+            dts = set(self.spec.dtypes)
+            sync_dt = dts.pop() if len(dts) == 1 else shard.dtype
+        pieces, off = [], 0
+        for spec_i, padded_i in zip(self.bucket_specs,
+                                    self._bucket_padded):
+            sz = padded_i // self.num_shards
+            piece = lax.dynamic_slice(shard, (off,), (sz,))
+            full = lax.all_gather(piece.astype(sync_dt), self.axis_name,
+                                  axis=0, tiled=True)
+            pieces += jax.tree_util.tree_leaves(
+                F.unflatten(full[: spec_i.total], spec_i))
+            off += sz
+        return jax.tree_util.tree_unflatten(self.spec.treedef, pieces)
+
+    def state_dict(self, state) -> dict:
+        d = super().state_dict(state)
+        d["flat_layout"]["n_buckets"] = self.n_buckets
+        return d
+
+    def load_state_dict(self, d: dict):
+        lay = d.get("flat_layout") or {}
+        if int(lay.get("n_buckets", 1)) != self.n_buckets:
+            raise ValueError(
+                f"DistributedFusedAdam: checkpoint n_buckets "
+                f"{lay.get('n_buckets', 1)} != configured "
+                f"{self.n_buckets} — the bucket-major shard layouts "
+                "differ")
+        return super().load_state_dict(d)
 
     def step(self, state: DistributedFusedAdamState, grads, lr=None,
              inv_scale=1.0, found_inf=False):
@@ -131,11 +208,12 @@ class DistributedFusedAdam(_ShardedFlat):
         Returns (full params pytree, new state).  The reduce-scatter
         averages over dp (≡ the reference's grad sync divide)."""
         ax = self.axis_name
-        g_flat = self._flatten_grads(grads)
-        # ZeRO-2 core: one reduce-scatter replaces DDP's allreduce
-        g_shard = (lax.psum_scatter(g_flat, ax, scatter_dimension=0,
-                                    tiled=True)
-                   / jnp.asarray(self.num_shards, g_flat.dtype))
+        # ZeRO-2 core: per-bucket reduce-scatters replace DDP's
+        # allreduce; each starts as soon as ITS leaves' grads exist
+        g_shard = jnp.concatenate([
+            lax.psum_scatter(gb, ax, scatter_dimension=0, tiled=True)
+            / jnp.asarray(self.num_shards, gb.dtype)
+            for gb in self._bucket_flats(grads, self.grad_sync_dtype)])
         found = jnp.asarray(found_inf)
         step_next = state.step + jnp.where(found, 0, 1).astype(jnp.int32)
         p, m, v = K.adam_flat(

@@ -2,78 +2,356 @@
 
 The reference's only long-sequence mechanism is Megatron SP + fixed-size
 FMHA kernels (SURVEY §5.7: no ring attention, no Ulysses).  For the TPU
-framework long context is first-class: the flash kernel's blockwise
-structure extends across chips —
+framework long context is first-class:
 
 * `ring_attention` — sequence (and KV) sharded over a mesh axis; KV
   chunks rotate around the ICI ring with `ppermute` while each device
-  accumulates its queries' online-softmax state (running max / denom /
-  output).  Peak memory per device: O(s_local²) scores, O(s_local·d)
-  KV — sequence length scales linearly with the ring size.
+  merges per-chunk blockwise-attention results into its queries'
+  running online-softmax state.  v2 design:
+
+  - each ring step runs the SAME blockwise flash kernel as single-chip
+    attention (`ops/flash_attention._fwd_impl`) on the resident
+    (s_local × s_local) chunk pair — the (s²) score matrix never
+    reaches HBM, on any backend (a jnp blockwise scan stands in for
+    Pallas off-TPU);
+  - a `custom_vjp` recomputes the backward from the saved (o, lse)
+    instead of AD-through-scan: per-device residuals are
+    q, k, v, o (s_local × d) + lse (s_local) — linear in s_local, NOT
+    the O(n · s_local²) of differentiating through the forward scan;
+  - causal chunks strictly above the diagonal are SKIPPED (a
+    `lax.switch` branch that touches no scores), not masked: a causal
+    ring costs ~half the FLOPs of the full ring;
+  - segment ids rotate with their KV chunk, so packed-varlen batches
+    work across the ring exactly as they do in-kernel.
+
+  Peak per-device memory: O(s_local · d) tensors + one (block × block)
+  score tile — global sequence length scales linearly with ring size.
 
 * `ulysses_attention` — all-to-all head scatter: convert seq-sharding
-  to head-sharding with `lax.all_to_all`, run dense (flash) attention
-  on full sequences of the local heads, convert back.  One collective
+  to head-sharding with `lax.all_to_all`, run (flash) attention on
+  full sequences of the local heads, convert back.  One collective
   pair per attention instead of n ring hops; needs heads % axis == 0.
 
-Both are differentiable (AD through scan/ppermute/all_to_all emits the
-reverse rotation) and compose with the TP layers (use a separate mesh
-axis or reuse "tp" when attention is not head-sharded).
+Both compose with the TP layers (use a separate mesh axis or reuse
+"tp" when attention is not head-sharded).  In-kernel attention dropout
+is not offered on the ring path (the coordinate-hash stream is local to
+each chunk call; use dropout on the projections instead).
 """
 
 from __future__ import annotations
 
+import functools
 import math
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
+from apex_tpu.ops._common import use_pallas
+from apex_tpu.ops.flash_attention import (
+    _bwd_impl,
+    _fwd_impl,
+    _pick_block,
+)
 
-def ring_attention(q, k, v, axis_name: str, *, causal: bool = False,
-                   softmax_scale: Optional[float] = None):
-    """Blockwise ring attention.
+_NEG_INF = -1e30
 
-    q, k, v: (b, h, s_local, d) — the LOCAL sequence shard; the global
-    sequence is the concatenation over the axis in rank order.
-    Returns the local output shard (b, h, s_local, d).
-    """
-    b, h, s_local, d = q.shape
+
+# ------------------------- per-chunk blockwise attention ---------------------
+
+def _jnp_blocks(sk, block_k):
+    if block_k is not None and sk % block_k:
+        raise ValueError(f"block_k={block_k} does not divide "
+                         f"s_local={sk}")
+    bk = block_k or _pick_block(sk, cap=1024)
+    if bk is None:
+        bk = sk  # no power-of-two divisor: single block
+    return bk, sk // bk
+
+
+def _chunk_fwd_jnp(q, k, v, scale, causal, q_seg, kv_seg, block_k):
+    """Blockwise online-softmax forward in plain jnp (the off-TPU stand-in
+    for the Pallas kernel): scans k-blocks so peak score memory is
+    (sq × block_k), never (sq × sk).  Returns (o, lse)."""
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    bk, nk = _jnp_blocks(sk, block_k)
+    q32 = q.astype(jnp.float32)
+    qpos = jnp.arange(sq)
+
+    def step(carry, t):
+        m, l, o = carry
+        k_t = lax.dynamic_slice_in_dim(k, t * bk, bk, 2).astype(jnp.float32)
+        v_t = lax.dynamic_slice_in_dim(v, t * bk, bk, 2).astype(jnp.float32)
+        s = jnp.einsum("bhqd,bhkd->bhqk", q32, k_t) * scale
+        if q_seg is not None:
+            ks_t = lax.dynamic_slice_in_dim(kv_seg, t * bk, bk, 1)
+            s = jnp.where(q_seg[:, None, :, None] != ks_t[:, None, None, :],
+                          _NEG_INF, s)
+        if causal:
+            kpos = t * bk + jnp.arange(bk)
+            s = jnp.where(kpos[None, :] > qpos[:, None], _NEG_INF, s)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m_new[..., None])
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=-1)
+        o_new = o * alpha[..., None] + jnp.einsum("bhqk,bhkd->bhqd", p, v_t)
+        return (m_new, l_new, o_new), None
+
+    m0 = jnp.full((b, h, sq), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, sq), jnp.float32)
+    o0 = jnp.zeros((b, h, sq, d), jnp.float32)
+    (m, l, o), _ = lax.scan(step, (m0, l0, o0), jnp.arange(nk))
+    l = jnp.maximum(l, 1e-30)
+    return (o / l[..., None]).astype(q.dtype), m + jnp.log(l)
+
+
+def _chunk_bwd_jnp(q, k, v, do, lse, delta, scale, causal, q_seg, kv_seg,
+                   block_k):
+    """Blockwise backward against the GLOBAL (lse, delta) — the partials
+    this produces sum across ring steps to the exact gradient."""
+    sk = k.shape[2]
+    bk, nk = _jnp_blocks(sk, block_k)
+    q32 = q.astype(jnp.float32)
+    do32 = do.astype(jnp.float32)
+    qpos = jnp.arange(q.shape[2])
+
+    def step(dq, t):
+        k_t = lax.dynamic_slice_in_dim(k, t * bk, bk, 2).astype(jnp.float32)
+        v_t = lax.dynamic_slice_in_dim(v, t * bk, bk, 2).astype(jnp.float32)
+        s = jnp.einsum("bhqd,bhkd->bhqk", q32, k_t) * scale
+        if q_seg is not None:
+            ks_t = lax.dynamic_slice_in_dim(kv_seg, t * bk, bk, 1)
+            s = jnp.where(q_seg[:, None, :, None] != ks_t[:, None, None, :],
+                          _NEG_INF, s)
+        if causal:
+            kpos = t * bk + jnp.arange(bk)
+            s = jnp.where(kpos[None, :] > qpos[:, None], _NEG_INF, s)
+        p = jnp.exp(s - lse[..., None])                    # global-normalized
+        dp = jnp.einsum("bhqd,bhkd->bhqk", do32, v_t)
+        ds = p * (dp - delta[..., None])
+        dq = dq + scale * jnp.einsum("bhqk,bhkd->bhqd", ds, k_t)
+        dk_t = scale * jnp.einsum("bhqk,bhqd->bhkd", ds, q32)
+        dv_t = jnp.einsum("bhqk,bhqd->bhkd", p, do32)
+        return dq, (dk_t, dv_t)
+
+    dq0 = jnp.zeros(q.shape[:3] + (q.shape[3],), jnp.float32)
+    dq, (dk_b, dv_b) = lax.scan(step, dq0, jnp.arange(nk))
+    # stacked (nk, b, h, bk, d) → (b, h, sk, d)
+    def unblock(x):
+        return jnp.moveaxis(x, 0, 2).reshape(k.shape[:2] + (sk, k.shape[3]))
+    return dq, unblock(dk_b), unblock(dv_b)
+
+
+def _chunk_fwd(q, k, v, scale, causal, q_seg, kv_seg, block_q, block_k,
+               pallas_path):
+    if pallas_path:
+        return _fwd_impl(q, k, v, scale, causal, 0.0, None, block_q,
+                         block_k, None, q_seg, kv_seg)
+    return _chunk_fwd_jnp(q, k, v, scale, causal, q_seg, kv_seg, block_k)
+
+
+def _chunk_bwd(q, k, v, o, lse, delta, do, scale, causal, q_seg, kv_seg,
+               block_q, block_k, pallas_path):
+    if pallas_path:
+        dq, dk, dv, _ = _bwd_impl(q, k, v, o, lse, do, scale, causal,
+                                  0.0, None, block_q, block_k, None,
+                                  q_seg, kv_seg)
+        return (dq.astype(jnp.float32), dk.astype(jnp.float32),
+                dv.astype(jnp.float32))
+    return _chunk_bwd_jnp(q, k, v, do, lse, delta, scale, causal,
+                          q_seg, kv_seg, block_k)
+
+
+# ------------------------------- ring core ----------------------------------
+
+def _merge(o_acc, lse_acc, o_c, lse_c):
+    """Merge a chunk's normalized (o, lse) into the running state —
+    the cross-chip half of online softmax."""
+    m = jnp.maximum(lse_acc, lse_c)
+    w1 = jnp.exp(lse_acc - m)
+    w2 = jnp.exp(lse_c - m)
+    wsum = w1 + w2
+    o = (o_acc * w1[..., None] + o_c.astype(jnp.float32) * w2[..., None]
+         ) / wsum[..., None]
+    return o, m + jnp.log(wsum)
+
+
+def _rotate(axis_name, n, tree):
+    perm = [(r, (r + 1) % n) for r in range(n)]
+    return jax.tree_util.tree_map(
+        lambda x: lax.ppermute(x, axis_name, perm), tree)
+
+
+def _ring_fwd_impl(q, k, v, q_seg, kv_seg, axis_name, causal, scale,
+                   block_q, block_k, pallas_path):
+    b, h, s, d = q.shape
     n = lax.axis_size(axis_name)
     rank = lax.axis_index(axis_name)
-    scale = softmax_scale if softmax_scale is not None else 1.0 / math.sqrt(d)
+    has_seg = q_seg is not None
 
-    q32 = q.astype(jnp.float32)
-    q_pos = rank * s_local + jnp.arange(s_local)          # global q rows
+    def attend(k_c, v_c, kseg_c, diag):
+        return _chunk_fwd(q, k_c, v_c, scale, causal and diag, q_seg,
+                          kseg_c, block_q, block_k, pallas_path)
 
     def step(carry, i):
-        m, l, o, kv = carry
-        k_i, v_i = kv
-        src = (rank - i) % n                              # chunk origin
-        s = jnp.einsum("bhqd,bhkd->bhqk", q32,
-                       k_i.astype(jnp.float32)) * scale
+        o_acc, lse_acc, k_c, v_c, kseg_c = carry
+        src = (rank - i) % n
+        kseg_arg = kseg_c if has_seg else None
         if causal:
-            kv_pos = src * s_local + jnp.arange(s_local)
-            mask = kv_pos[None, :] > q_pos[:, None]       # (s_local, s_local)
-            s = jnp.where(mask[None, None], -1e30, s)
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
-        p = jnp.exp(s - m_new)
-        alpha = jnp.exp(m - m_new)
-        l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
-        o_new = o * alpha + jnp.einsum("bhqk,bhkd->bhqd", p,
-                                       v_i.astype(jnp.float32))
-        # rotate KV to the next rank (ICI neighbour exchange)
-        perm = [(r, (r + 1) % n) for r in range(n)]
-        kv_next = jax.tree_util.tree_map(
-            lambda x: lax.ppermute(x, axis_name, perm), (k_i, v_i))
-        return (m_new, l_new, o_new, kv_next), None
+            # strictly-above-diagonal chunks (src > rank) are fully
+            # masked: the skip branch runs NO score work — a causal
+            # ring does ~half the FLOPs of a full ring
+            def do_skip(_):
+                return o_acc, lse_acc
 
-    m0 = jnp.full((b, h, s_local, 1), -1e30, jnp.float32)
-    l0 = jnp.zeros((b, h, s_local, 1), jnp.float32)
-    o0 = jnp.zeros((b, h, s_local, d), jnp.float32)
-    (m, l, o, _), _ = lax.scan(step, (m0, l0, o0, (k, v)), jnp.arange(n))
-    return (o / jnp.maximum(l, 1e-30)).astype(q.dtype)
+            def do_diag(_):
+                return _merge(o_acc, lse_acc,
+                              *attend(k_c, v_c, kseg_arg, True))
+
+            def do_full(_):
+                return _merge(o_acc, lse_acc,
+                              *attend(k_c, v_c, kseg_arg, False))
+
+            idx = jnp.where(src > rank, 0, jnp.where(src == rank, 1, 2))
+            o_acc, lse_acc = lax.switch(idx, (do_skip, do_diag, do_full),
+                                        None)
+        else:
+            o_acc, lse_acc = _merge(o_acc, lse_acc,
+                                    *attend(k_c, v_c, kseg_arg, False))
+        k_c, v_c = _rotate(axis_name, n, (k_c, v_c))
+        if has_seg:
+            kseg_c = _rotate(axis_name, n, kseg_c)
+        return (o_acc, lse_acc, k_c, v_c, kseg_c), None
+
+    o0 = jnp.zeros((b, h, s, d), jnp.float32)
+    lse0 = jnp.full((b, h, s), _NEG_INF, jnp.float32)
+    kseg0 = kv_seg if has_seg else jnp.zeros((), jnp.int32)
+    (o, lse, *_), _ = lax.scan(step, (o0, lse0, k, v, kseg0),
+                               jnp.arange(n))
+    return o.astype(q.dtype), lse
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10))
+def _ring(q, k, v, q_seg, kv_seg, axis_name, causal, scale, block_q,
+          block_k, pallas_path):
+    o, _ = _ring_fwd_impl(q, k, v, q_seg, kv_seg, axis_name, causal,
+                          scale, block_q, block_k, pallas_path)
+    return o
+
+
+def _ring_vjp_fwd(q, k, v, q_seg, kv_seg, axis_name, causal, scale,
+                  block_q, block_k, pallas_path):
+    o, lse = _ring_fwd_impl(q, k, v, q_seg, kv_seg, axis_name, causal,
+                            scale, block_q, block_k, pallas_path)
+    # residuals are O(s_local · d) per device — blockwise recompute in
+    # backward replaces AD-through-scan's O(n · s_local²) saved scores
+    return o, (q, k, v, q_seg, kv_seg, o, lse)
+
+
+def _ring_vjp_bwd(axis_name, causal, scale, block_q, block_k, pallas_path,
+                  res, do):
+    q, k, v, q_seg, kv_seg, o, lse = res
+    n = lax.axis_size(axis_name)
+    rank = lax.axis_index(axis_name)
+    has_seg = q_seg is not None
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    zero_kd = jnp.zeros(k.shape, jnp.float32)
+
+    def partials(k_c, v_c, kseg_c, diag):
+        return _chunk_bwd(q, k_c, v_c, o, lse, delta, do, scale,
+                          causal and diag, q_seg, kseg_c, block_q,
+                          block_k, pallas_path)
+
+    def step(carry, i):
+        # dk/dv accumulators TRAVEL with their kv chunk: after n
+        # rotations each has collected every rank's contribution and is
+        # back home (≡ ring-attention backward; no gather of n shards)
+        dq_acc, k_c, v_c, kseg_c, dk_c, dv_c = carry
+        src = (rank - i) % n
+        kseg_arg = kseg_c if has_seg else None
+        if causal:
+            def do_skip(_):
+                return (jnp.zeros(q.shape, jnp.float32), zero_kd, zero_kd)
+
+            def do_diag(_):
+                return partials(k_c, v_c, kseg_arg, True)
+
+            def do_full(_):
+                return partials(k_c, v_c, kseg_arg, False)
+
+            idx = jnp.where(src > rank, 0, jnp.where(src == rank, 1, 2))
+            dq_p, dk_p, dv_p = lax.switch(
+                idx, (do_skip, do_diag, do_full), None)
+        else:
+            dq_p, dk_p, dv_p = partials(k_c, v_c, kseg_arg, False)
+        dq_acc = dq_acc + dq_p
+        dk_c = dk_c + dk_p
+        dv_c = dv_c + dv_p
+        k_c, v_c, dk_c, dv_c = _rotate(axis_name, n,
+                                       (k_c, v_c, dk_c, dv_c))
+        if has_seg:
+            kseg_c = _rotate(axis_name, n, kseg_c)
+        return (dq_acc, k_c, v_c, kseg_c, dk_c, dv_c), None
+
+    kseg0 = kv_seg if has_seg else jnp.zeros((), jnp.int32)
+    carry0 = (jnp.zeros(q.shape, jnp.float32), k, v, kseg0,
+              zero_kd, zero_kd)
+    (dq, _, _, _, dk, dv), _ = lax.scan(step, carry0, jnp.arange(n))
+
+    def _int_zero(x):
+        return (None if x is None
+                else np.zeros(x.shape, dtype=jax.dtypes.float0))
+
+    return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype),
+            _int_zero(q_seg), _int_zero(kv_seg))
+
+
+_ring.defvjp(_ring_vjp_fwd, _ring_vjp_bwd)
+
+
+# -------------------------------- public API --------------------------------
+
+def ring_attention(q, k, v, axis_name: str, *, causal: bool = False,
+                   softmax_scale: Optional[float] = None,
+                   segment_ids=None, q_segment_ids=None,
+                   kv_segment_ids=None,
+                   block_q: Optional[int] = None,
+                   block_k: Optional[int] = None,
+                   use_pallas_override: Optional[bool] = None):
+    """Blockwise ring attention (see module docstring for the design).
+
+    q, k, v: (b, h, s_local, d) — the LOCAL sequence shard; the global
+    sequence is the concatenation over the axis in rank order.  Segment
+    ids are (b, s_local) int per shard, global semantics (tokens attend
+    only within equal ids, across shards).  Returns the local output
+    shard (b, h, s_local, d).
+    """
+    d = q.shape[-1]
+    scale = (softmax_scale if softmax_scale is not None
+             else 1.0 / math.sqrt(d))
+    if segment_ids is not None:
+        if q_segment_ids is not None or kv_segment_ids is not None:
+            raise ValueError(
+                "pass either segment_ids or q_/kv_segment_ids, not both")
+        q_segment_ids = kv_segment_ids = segment_ids
+    if (q_segment_ids is None) != (kv_segment_ids is None):
+        raise ValueError("q_segment_ids and kv_segment_ids go together")
+    b, s = q.shape[0], q.shape[2]
+    if q_segment_ids is not None:
+        q_segment_ids = jnp.asarray(q_segment_ids, jnp.int32)
+        kv_segment_ids = jnp.asarray(kv_segment_ids, jnp.int32)
+        if (q_segment_ids.shape != (b, s)
+                or kv_segment_ids.shape != (b, s)):
+            raise ValueError(
+                f"segment id shapes {q_segment_ids.shape}/"
+                f"{kv_segment_ids.shape} != ({b}, {s})")
+    pallas_path = bool(use_pallas(use_pallas_override)
+                       and _pick_block(s))
+    return _ring(q, k, v, q_segment_ids, kv_segment_ids, axis_name,
+                 causal, scale, block_q, block_k, pallas_path)
 
 
 def ulysses_attention(q, k, v, axis_name: str, *, causal: bool = False,

@@ -39,6 +39,7 @@ from apex_tpu.parallel.mesh import PP_AXIS
 def spmd_pipeline(stage_fn: Callable, stage_params, microbatches, *,
                   axis_name: str = PP_AXIS, num_model_chunks: int = 1,
                   remat_stage: bool = False,
+                  checkpoint_window: Optional[int] = None,
                   loss_fn: Optional[Callable] = None, loss_args=None):
     """Run `microbatches` through pp × num_model_chunks sequential stages.
 
@@ -57,6 +58,19 @@ def spmd_pipeline(stage_fn: Callable, stage_params, microbatches, *,
     and only the SCALAR loss sum crosses the pp axis (≡ the reference,
     which computes loss on the last stage only — schedules/common.py:
     253-322 — and never ships activations backwards).
+
+    checkpoint_window: the 1F1B activation-memory dial (≡ the partial
+    activation-checkpoint window of the reference's 1F1B,
+    fwd_bwd_pipelining_without_interleaving.py:351-362).  AD of the
+    plain clocked scan saves residuals for EVERY clock — GPipe-shaped
+    O(m) per-stage activation memory.  A window of w clocks wraps each
+    w-clock slice in `jax.checkpoint`: backward recomputes one slice at
+    a time, so in-flight residuals are O(w) plus O(clocks/w) saved
+    window-boundary carries (one microbatch activation each).  With
+    w = pp the peak is O(pp + m/pp) activations — the 1F1B bound — at
+    the cost of one extra forward pass of the windowed clocks.  Applies
+    to the scalar-loss mode (with loss_fn); the stacked-output mode
+    carries the (m, ...) buffer either way.
 
     Call inside shard_map; this device holds its pp shard of
     stage_params.  Differentiable: AD yields the reverse pipeline.
@@ -117,7 +131,8 @@ def spmd_pipeline(stage_fn: Callable, stage_params, microbatches, *,
             return (x_next, acc), None
 
         x0 = jnp.zeros(mb_shape, dtype)
-        (xf, acc), _ = lax.scan(clock1, (x0, acc0), jnp.arange(clocks))
+        (xf, acc) = _scan_clocks(clock1, (x0, acc0), clocks,
+                                 checkpoint_window)
         return finish(acc)
 
     # interleaved: iterate chunks sequentially per clock with a ring
@@ -153,9 +168,30 @@ def spmd_pipeline(stage_fn: Callable, stage_params, microbatches, *,
         return (jnp.stack(nxt), acc), None
 
     xs0 = jnp.zeros((num_model_chunks,) + mb_shape, dtype)
-    (xsf, acc), _ = lax.scan(clockN, (xs0, acc0),
-                             jnp.arange(m + total_stages - 1))
+    (xsf, acc) = _scan_clocks(clockN, (xs0, acc0), clocks,
+                              checkpoint_window)
     return finish(acc)
+
+
+def _scan_clocks(clock_fn, carry0, clocks, checkpoint_window):
+    """Scan the clock loop, optionally in `jax.checkpoint`ed windows.
+
+    Padding clocks past `clocks` are no-ops by construction: the
+    feed index is clipped, the k/kk validity windows gate every write,
+    and the extra ring shifts rotate ignored buffers."""
+    if not checkpoint_window or checkpoint_window >= clocks:
+        carry, _ = lax.scan(clock_fn, carry0, jnp.arange(clocks))
+        return carry
+    w = checkpoint_window
+    n_win = -(-clocks // w)
+
+    def window(carry, ts_w):
+        carry, _ = lax.scan(clock_fn, carry, ts_w)
+        return carry, None
+
+    carry, _ = lax.scan(jax.checkpoint(window), carry0,
+                        jnp.arange(n_win * w).reshape(n_win, w))
+    return carry
 
 
 def _broadcast_from_last(out, stage, pp, axis_name):
@@ -198,7 +234,8 @@ def forward_backward_no_pipelining(forward_step_func, batch, model_params, *,
 
 def forward_backward_pipelining_without_interleaving(
         stage_fn, stage_params, microbatches, loss_fn, *,
-        axis_name: str = PP_AXIS, remat_stage: bool = False):
+        axis_name: str = PP_AXIS, remat_stage: bool = False,
+        checkpoint_window: Optional[int] = None):
     """1F1B-equivalent SPMD pipeline ≡
     fwd_bwd_pipelining_without_interleaving.py:241-597.
 
@@ -209,6 +246,7 @@ def forward_backward_pipelining_without_interleaving(
     """
     total = spmd_pipeline(stage_fn, stage_params, microbatches,
                           axis_name=axis_name, remat_stage=remat_stage,
+                          checkpoint_window=checkpoint_window,
                           loss_fn=lambda y, _: loss_fn(y), loss_args=None)
     return total / microbatches.shape[0]
 
@@ -216,13 +254,15 @@ def forward_backward_pipelining_without_interleaving(
 def forward_backward_pipelining_with_interleaving(
         stage_fn, stage_params, microbatches, loss_fn, *,
         num_model_chunks: int, axis_name: str = PP_AXIS,
-        remat_stage: bool = False):
+        remat_stage: bool = False,
+        checkpoint_window: Optional[int] = None):
     """Interleaved/virtual-pp schedule ≡
     fwd_bwd_pipelining_with_interleaving.py:27-744."""
     total = spmd_pipeline(stage_fn, stage_params, microbatches,
                           axis_name=axis_name,
                           num_model_chunks=num_model_chunks,
                           remat_stage=remat_stage,
+                          checkpoint_window=checkpoint_window,
                           loss_fn=lambda y, _: loss_fn(y), loss_args=None)
     return total / microbatches.shape[0]
 

@@ -29,6 +29,31 @@ import jax.numpy as jnp
 import numpy as np
 
 
+_TRANSIENT = ("remote_compile", "response body", "UNAVAILABLE",
+              "DEADLINE_EXCEEDED", "Connection", "INTERNAL: http")
+
+
+def _retry(fn, *args, attempts=3):
+    """Bounded retry for transient remote-compile/tunnel flakes (the
+    round-3 BERT number was lost to a single 'response body closed'
+    read error — VERDICT r3 weak #2).  Non-transient errors raise
+    immediately; transient ones get `attempts` tries with a pause."""
+    import gc
+
+    last = None
+    for i in range(attempts):
+        try:
+            return fn(*args)
+        except Exception as e:  # noqa: BLE001 — classify then re-raise
+            msg = repr(e)
+            if not any(t in msg for t in _TRANSIENT):
+                raise
+            last = e
+            gc.collect()
+            time.sleep(2.0 * (i + 1))
+    raise last
+
+
 def _time_steps(step, state, tokens, labels, iters, warmup):
     for _ in range(warmup):
         state, loss = step(state, tokens, labels)
@@ -251,6 +276,87 @@ def _bert_seq_per_sec(on_tpu):
     return batch / dt
 
 
+def _resnet50_img_per_sec(on_tpu):
+    """ResNet-50 AMP-O1 fused train step, synthetic data, batch 256 —
+    the Speed meter of the reference's canonical example
+    (examples/imagenet/main_amp.py:386-397; see examples/imagenet_amp.py
+    for the full training loop).  Round-3 measurement: 1,649 img/s/chip
+    (docs/PERF.md) — this puts it in the driver JSON."""
+    from jax.sharding import PartitionSpec as P
+
+    from apex_tpu import amp
+    from apex_tpu.models.resnet import ResNet
+    from apex_tpu.ops.xentropy import softmax_cross_entropy_loss
+    from apex_tpu.optimizers.fused_sgd import FusedSGD
+    from apex_tpu.parallel import ddp
+    from apex_tpu.parallel import mesh as M
+
+    batch, size, arch = (256, 224, "resnet50") if on_tpu else \
+        (4, 32, "resnet18")
+    M.destroy_model_parallel()
+    mesh = M.initialize_model_parallel(devices=jax.devices()[:1])
+    model = ResNet(arch, num_classes=1000, axis_name="dp")
+    params, mstate = model.init(jax.random.PRNGKey(0))
+    amp_state = amp.initialize(opt_level="O1")
+
+    def loss_fn(p, ms, b):
+        x, y = b
+        logits, new_ms = model.apply(p, ms, x, training=True)
+        return jnp.mean(softmax_cross_entropy_loss(
+            logits.astype(jnp.float32), y)), new_ms
+
+    opt = FusedSGD(lr=0.1, momentum=0.9, weight_decay=1e-4)
+    state = opt.init(params)
+    scaler = amp_state.loss_scalers[0]
+    step = ddp.make_train_step(loss_fn, opt, mesh, amp_state=amp_state,
+                               batch_spec=(P("dp"), P("dp")),
+                               with_state=True)
+    x = jax.random.normal(jax.random.PRNGKey(1), (batch, size, size, 3))
+    y = jax.random.randint(jax.random.PRNGKey(2), (batch,), 0, 1000)
+    iters, warmup = (10, 2) if on_tpu else (2, 1)
+    for _ in range(warmup):
+        state, scaler, mstate, loss = step(state, scaler, mstate, (x, y))
+    _ = np.asarray(loss)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        state, scaler, mstate, loss = step(state, scaler, mstate, (x, y))
+    _ = np.asarray(loss)
+    dt = (time.perf_counter() - t0) / iters
+    M.destroy_model_parallel()
+    return batch / dt
+
+
+def _adam_1b_step_ms(on_tpu):
+    """Fused flat-buffer Adam step at 1B params (fp32 p/m/v, bf16
+    grads) — the large-param optimizer north star (BASELINE.md;
+    ≡ tests/L0/run_optimizers scale point).  Round-3: 44.4 ms ≈ 721
+    GB/s effective (docs/PERF.md)."""
+    from apex_tpu.ops import optimizer_kernels as K
+
+    n = 10 ** 9 if on_tpu else 10 ** 6
+    n = -(-n // K.FLAT_TILE) * K.FLAT_TILE
+    p = jnp.zeros((n,), jnp.float32)
+    m = jnp.zeros((n,), jnp.float32)
+    v = jnp.zeros((n,), jnp.float32)
+    g = jnp.full((n,), 1e-3, jnp.bfloat16)
+
+    def _step(p, m, v, g):
+        return K.adam_flat(p, m, v, g, lr=1e-3, step=10,
+                           weight_decay=0.01,
+                           use_pallas_override=on_tpu or None)
+
+    step = jax.jit(_step, donate_argnums=(0, 1, 2))
+    iters, warmup = (20, 3) if on_tpu else (3, 1)
+    for _ in range(warmup):
+        p, m, v = step(p, m, v, g)
+    np.asarray(p[:1])
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        p, m, v = step(p, m, v, g)
+    np.asarray(p[:1])
+    return (time.perf_counter() - t0) / iters * 1e3
+
+
 def main():
     from apex_tpu.models.gpt import GPTConfig
 
@@ -269,7 +375,7 @@ def main():
         cfg = GPTConfig(vocab_size=512, seq_len=seq, hidden=64,
                         num_layers=2, num_heads=4, dropout=0.0)
 
-    fused = _fused_tokens_per_sec(on_tpu, batch, seq, cfg)
+    fused = _retry(_fused_tokens_per_sec, on_tpu, batch, seq, cfg)
     result = {
         "metric": "gpt350m_train_tokens_per_sec_per_chip",
         "value": round(fused, 1),
@@ -277,27 +383,38 @@ def main():
         "vs_baseline": None,  # measured below; null = baseline didn't run
     }
     try:
-        baseline, bl_batch = _baseline_best(on_tpu, batch, seq, cfg)
+        baseline, bl_batch = _retry(_baseline_best, on_tpu, batch, seq, cfg)
         result["baseline_tokens_per_sec"] = round(baseline, 1)
         result["baseline_batch"] = bl_batch
         result["vs_baseline"] = round(fused / baseline, 2)
     except Exception as e:  # keep the primary metric even if the
         result["baseline_error"] = repr(e)[:120]  # baseline OOMs/fails
     try:
-        mha_fused, mha_unfused = _mha_latencies(on_tpu)
+        mha_fused, mha_unfused = _retry(_mha_latencies, on_tpu)
         result["mha_fused_fwd_bwd_ms"] = round(mha_fused, 2)
         result["mha_unfused_fwd_bwd_ms"] = round(mha_unfused, 2)
     except Exception as e:
         result["mha_error"] = repr(e)[:120]
     try:
         result["gpt1p3b_tokens_per_sec_per_chip"] = round(
-            _gpt1p3b_tokens_per_sec(on_tpu), 1)
+            _retry(_gpt1p3b_tokens_per_sec, on_tpu), 1)
     except Exception as e:
         result["gpt1p3b_error"] = repr(e)[:120]
     try:
-        result["bert_seq_per_sec"] = round(_bert_seq_per_sec(on_tpu), 1)
+        result["bert_seq_per_sec"] = round(
+            _retry(_bert_seq_per_sec, on_tpu), 1)
     except Exception as e:
         result["bert_error"] = repr(e)[:120]
+    try:
+        result["resnet50_img_per_sec"] = round(
+            _retry(_resnet50_img_per_sec, on_tpu), 1)
+    except Exception as e:
+        result["resnet50_error"] = repr(e)[:120]
+    try:
+        result["adam_1b_step_ms"] = round(
+            _retry(_adam_1b_step_ms, on_tpu), 2)
+    except Exception as e:
+        result["adam_1b_error"] = repr(e)[:120]
     print(json.dumps(result))
 
 

@@ -79,3 +79,176 @@ def test_ulysses_matches_dense(causal):
     want = attention_reference(q, k, v, causal=causal)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_attention_segment_ids(causal):
+    """Packed-varlen segments with GLOBAL semantics across the ring —
+    segments deliberately span shard boundaries (s_local=8, seg len 12)."""
+    mesh = M.initialize_model_parallel(tensor_model_parallel_size=N)
+    q, k, v = _qkv(2, 2, 64, 16, seed=3)
+    seg = (jnp.arange(64) // 12)[None, :].repeat(2, axis=0)
+
+    f = shard_map(
+        lambda q, k, v, s: ring_attention(q, k, v, "tp", causal=causal,
+                                          segment_ids=s),
+        mesh=mesh,
+        in_specs=(P(None, None, "tp"),) * 3 + (P(None, "tp"),),
+        out_specs=P(None, None, "tp"), check_vma=False)
+    got = f(q, k, v, seg)
+    want = attention_reference(q, k, v, causal=causal,
+                               q_segment_ids=seg, kv_segment_ids=seg)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_ring_attention_segment_grads():
+    mesh = M.initialize_model_parallel(tensor_model_parallel_size=N)
+    q, k, v = _qkv(1, 1, 64, 16, seed=4)
+    seg = (jnp.arange(64) // 24)[None, :]
+
+    def local_grads(q, k, v, s):
+        def loss(q, k, v):
+            o = ring_attention(q, k, v, "tp", causal=True, segment_ids=s)
+            return jnp.sum(o ** 2)
+        return jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+
+    spec = P(None, None, "tp")
+    g = shard_map(local_grads, mesh=mesh,
+                  in_specs=(spec,) * 3 + (P(None, "tp"),),
+                  out_specs=(spec,) * 3, check_vma=False)(q, k, v, seg)
+    r = jax.grad(
+        lambda q, k, v: jnp.sum(attention_reference(
+            q, k, v, causal=True, q_segment_ids=seg,
+            kv_segment_ids=seg) ** 2), argnums=(0, 1, 2))(q, k, v)
+    for a, e, n in zip(g, r, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(e),
+                                   rtol=1e-3, atol=1e-3, err_msg=f"d{n}")
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_attention_pallas_path(causal):
+    """The TPU kernel path (interpret mode on CPU) through the ring:
+    per-chunk Pallas flash fwd/bwd inside the scan/switch."""
+    mesh = M.initialize_model_parallel(tensor_model_parallel_size=N)
+    q, k, v = _qkv(1, 1, 64, 16, seed=5)
+
+    def local_grads(q, k, v):
+        def loss(q, k, v):
+            o = ring_attention(q, k, v, "tp", causal=causal,
+                               use_pallas_override=True)
+            return jnp.sum(o ** 2)
+        o = ring_attention(q, k, v, "tp", causal=causal,
+                           use_pallas_override=True)
+        return (o,) + jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+
+    spec = P(None, None, "tp")
+    o, gq, gk, gv = shard_map(local_grads, mesh=mesh,
+                              in_specs=(spec,) * 3,
+                              out_specs=(spec,) * 4,
+                              check_vma=False)(q, k, v)
+    want = attention_reference(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(o), np.asarray(want),
+                               rtol=1e-4, atol=1e-4)
+    r = jax.grad(
+        lambda q, k, v: jnp.sum(attention_reference(
+            q, k, v, causal=causal) ** 2), argnums=(0, 1, 2))(q, k, v)
+    for a, e, n in zip((gq, gk, gv), r, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(e),
+                                   rtol=1e-3, atol=1e-3, err_msg=f"d{n}")
+
+
+def test_ring_attention_causal_skips_chunks():
+    """The causal ring must SKIP above-diagonal chunks (a lax.switch /
+    HLO conditional whose skip branch does no score work), not mask
+    them — check the conditional survives into the lowered HLO."""
+    mesh = M.initialize_model_parallel(tensor_model_parallel_size=N)
+    q = jnp.zeros((1, 1, 64, 16))
+
+    f = shard_map(
+        lambda q, k, v: ring_attention(q, k, v, "tp", causal=True),
+        mesh=mesh, in_specs=(P(None, None, "tp"),) * 3,
+        out_specs=P(None, None, "tp"), check_vma=False)
+    hlo = jax.jit(f).lower(q, q, q).as_text()
+    # StableHLO spells the 3-way branch `stablehlo.case`
+    assert "case" in hlo, "causal ring lost its skip branch"
+
+
+def _ring_grad_temp_bytes(S, d=32):
+    """Compiled temp size of a full ring fwd+bwd at global seq S on the
+    8-way mesh — the residual-memory probe."""
+    mesh = M.initialize_model_parallel(tensor_model_parallel_size=N)
+    q = jnp.zeros((1, 1, S, d), jnp.float32)
+
+    def loss(q, k, v):
+        return jnp.sum(ring_attention(q, k, v, "tp", causal=True) ** 2)
+
+    f = shard_map(jax.grad(loss, argnums=(0, 1, 2)), mesh=mesh,
+                  in_specs=(P(None, None, "tp"),) * 3,
+                  out_specs=(P(None, None, "tp"),) * 3, check_vma=False)
+    stats = jax.jit(f).lower(q, q, q).compile().memory_analysis()
+    M.destroy_model_parallel()
+    return stats.temp_size_in_bytes
+
+
+def test_ring_attention_memory_linear_in_s_local():
+    """custom_vjp residuals are O(s_local · d): doubling the sequence
+    doubles compiled temp memory (AD-through-scan would keep
+    O(n · s_local²) saved score blocks — ratio ~4 and a huge base)."""
+    t16 = _ring_grad_temp_bytes(16384)
+    t32 = _ring_grad_temp_bytes(32768)
+    ratio = t32 / t16
+    assert ratio < 2.6, (t16, t32, ratio)
+    # absolute sanity: 32k tokens fwd+bwd in well under n*s_local^2
+    # (8 * 4096^2 * 4B = 512 MB); measured ~55 MB
+    assert t32 < 200 * 1024 * 1024, t32
+
+
+def test_ring_attention_128k_causal_fwd_bwd():
+    """128k-token causal fwd+bwd on the 8-way mesh (s_local = 16k).
+
+    Parity oracle at this scale: segment ids with length 5120 (NOT a
+    divisor of s_local, so segments span shard boundaries) make global
+    attention block-diagonal — each segment's output and grads must
+    match dense causal attention run on that segment alone.  Verifies a
+    shard-interior segment and one spanning the rank0/rank1 boundary."""
+    mesh = M.initialize_model_parallel(tensor_model_parallel_size=N)
+    S, d, SEG = 131072, 32, 5120
+    ks = jax.random.split(jax.random.PRNGKey(7), 3)
+    q = jax.random.normal(ks[0], (1, 1, S, d), jnp.float32)
+    k = jax.random.normal(ks[1], (1, 1, S, d), jnp.float32)
+    v = jax.random.normal(ks[2], (1, 1, S, d), jnp.float32)
+    seg = (jnp.arange(S) // SEG)[None, :]
+
+    def local(q, k, v, s):
+        def loss(q, k, v):
+            o = ring_attention(q, k, v, "tp", causal=True, segment_ids=s)
+            return jnp.sum(o ** 2)
+        o = ring_attention(q, k, v, "tp", causal=True, segment_ids=s)
+        gq, gk, gv = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+        return o, gq, gk, gv
+
+    spec = P(None, None, "tp")
+    o, gq, gk, gv = jax.jit(shard_map(
+        local, mesh=mesh, in_specs=(spec,) * 3 + (P(None, "tp"),),
+        out_specs=(spec,) * 4, check_vma=False))(q, k, v, seg)
+
+    # segment 3 sits inside rank 0; segment 3*5120=15360..20480 spans
+    # the 16384 rank boundary
+    for g in (1, 3, 12):
+        lo, hi = g * SEG, (g + 1) * SEG
+        qs, ks_, vs = q[:, :, lo:hi], k[:, :, lo:hi], v[:, :, lo:hi]
+
+        def seg_loss(qs, ks_, vs):
+            return jnp.sum(attention_reference(qs, ks_, vs,
+                                               causal=True) ** 2)
+
+        want_o = attention_reference(qs, ks_, vs, causal=True)
+        want_g = jax.grad(seg_loss, argnums=(0, 1, 2))(qs, ks_, vs)
+        np.testing.assert_allclose(np.asarray(o[:, :, lo:hi]),
+                                   np.asarray(want_o), rtol=2e-4,
+                                   atol=2e-4, err_msg=f"o seg{g}")
+        for a, e, nm in zip((gq, gk, gv), want_g, "qkv"):
+            np.testing.assert_allclose(np.asarray(a[:, :, lo:hi]),
+                                       np.asarray(e), rtol=2e-3,
+                                       atol=2e-3, err_msg=f"d{nm} seg{g}")

@@ -328,3 +328,94 @@ def test_dist_lamb_single_full_size_allgather_hlo():
                           txt))
     assert n_ag == 1, f"expected exactly 1 all-gather (param sync), got {n_ag}"
     M.destroy_model_parallel()
+
+
+# --------- bucketed backward-overlap grad sync (round 4: VERDICT #4) --------
+
+def _gpt_like_params(key, n_layers=6, h=64):
+    ks = jax.random.split(key, n_layers)
+    return {f"block{i}": {"w1": jax.random.normal(k, (h, 4 * h)) * 0.02,
+                          "w2": jax.random.normal(k, (4 * h, h)) * 0.02,
+                          "b": jnp.zeros((h,))}
+            for i, k in enumerate(ks)}
+
+
+def test_dist_adam_bucketed_matches_single_bucket():
+    """n_buckets=4 (bucket-major shard layout, 4 reduce-scatters) must
+    produce bit-identical FULL params to the single-bucket step."""
+    mesh = M.initialize_model_parallel()
+    params = _gpt_like_params(jax.random.PRNGKey(0))
+    base = _gpt_like_params(jax.random.PRNGKey(1))
+
+    def run(n_buckets, steps=3):
+        opt = DistributedFusedAdam(num_shards=DP, lr=1e-2,
+                                   weight_decay=0.01,
+                                   n_buckets=n_buckets, use_pallas=False)
+        sspec = DistributedFusedAdamState(P(), P("dp"), P("dp"), P("dp"))
+        state = jax.jit(shard_map(opt.init, mesh=mesh, in_specs=(P(),),
+                                  out_specs=sspec, check_vma=False))(params)
+
+        def local_step(state, g):
+            rank = jax.lax.axis_index("dp").astype(jnp.float32)
+            grads = jax.tree_util.tree_map(
+                lambda x: x * (1.0 + 0.1 * rank), g)
+            return opt.step(state, grads)
+
+        step = jax.jit(shard_map(local_step, mesh=mesh,
+                                 in_specs=(sspec, P()),
+                                 out_specs=(P(), sspec),
+                                 check_vma=False))
+        p = None
+        for _ in range(steps):
+            p, state = step(state, base)
+        return p
+
+    p1 = run(1)
+    p4 = run(4)
+    flat1 = jax.tree_util.tree_leaves(p1)
+    flat4 = jax.tree_util.tree_leaves(p4)
+    for a, b in zip(flat1, flat4):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_dist_adam_bucketed_reduce_scatters_interleavable():
+    """The lowered train step must contain >= n_buckets reduce-scatters
+    whose operands are per-bucket (NOT one fused buffer), with the
+    first reduce-scatter appearing before the last backward matmul —
+    i.e. the schedule is free to overlap grad sync with backward
+    (≡ the reference's per-bucket grad hooks)."""
+    mesh = M.initialize_model_parallel()
+    params = _gpt_like_params(jax.random.PRNGKey(0))
+    opt = DistributedFusedAdam(num_shards=DP, lr=1e-2, n_buckets=4,
+                               use_pallas=False)
+    sspec = DistributedFusedAdamState(P(), P("dp"), P("dp"), P("dp"))
+    state = jax.jit(shard_map(opt.init, mesh=mesh, in_specs=(P(),),
+                              out_specs=sspec, check_vma=False))(params)
+    x = jax.random.normal(jax.random.PRNGKey(2), (16, 64))
+
+    def local_step(state, x):
+        full = opt.full_params(state)
+
+        def loss(p):
+            h = x
+            for i in range(6):
+                blk = p[f"block{i}"]
+                h = h + jnp.tanh(h @ blk["w1"]) @ blk["w2"] + blk["b"]
+            return jnp.mean(h ** 2)
+
+        grads = jax.grad(loss)(full)
+        return opt.step(state, grads)
+
+    step = jax.jit(shard_map(local_step, mesh=mesh, in_specs=(sspec, P()),
+                             out_specs=(P(), sspec), check_vma=False))
+    # optimized HLO (post-fusion, scheduled) — not just stablehlo
+    hlo = step.lower(state, x).compile().as_text()
+    n_rs = hlo.count("reduce-scatter(")
+    assert n_rs >= 4, f"expected >=4 per-bucket reduce-scatters, {n_rs}"
+    first_rs = hlo.index("reduce-scatter(")
+    last_dot = max(hlo.rfind(" dot("), hlo.rfind(" dot."),
+                   hlo.rfind("= dot"))
+    assert last_dot > 0, "no dots found in optimized HLO"
+    assert first_rs < last_dot, (
+        "all reduce-scatters sit after the last backward dot — "
+        "no overlap is possible")

