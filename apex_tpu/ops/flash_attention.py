@@ -808,6 +808,11 @@ def flash_attention(q, k, v, *, causal: bool = False,
                     dropout_key=None,
                     block_q: Optional[int] = None,
                     block_k: Optional[int] = None,
+                    # True by default DELIBERATELY: a trainable bias
+                    # silently freezing (the round-3 contract) is wrong
+                    # training with no error; the full-bias dbias
+                    # buffer this costs is a loud, debuggable OOM whose
+                    # opt-out (bias_grad=False) is documented below.
                     bias_grad: bool = True,
                     use_pallas_override: Optional[bool] = None):
     """Flash attention over (batch, heads, seq, head_dim).

@@ -365,9 +365,9 @@ def _lamb_phase1_kernel(m_ref, v_ref, g_ref, p_ref, sc_ref,
     u = (m_new * sc_ref[5, 0]) / (jnp.sqrt(v_new * sc_ref[6, 0]) + eps)
     if weight_decay != 0.0:
         u = u + weight_decay * p
-    m_out[...] = m_new
-    v_out[...] = v_new
-    u_out[...] = u
+    m_out[...] = m_new.astype(m_out.dtype)
+    v_out[...] = v_new.astype(v_out.dtype)
+    u_out[...] = u.astype(u_out.dtype)
 
 
 def _lamb_phase2_kernel(p_ref, u_ref, r_ref, sc_ref, p_out):
@@ -414,7 +414,8 @@ def lamb_phase1_flat(m, v, g, p, clip_ratio, step, *, beta1, beta2, eps,
             jnp.sqrt(v_new * scalars[6, 0]) + eps)
         if weight_decay:
             u = u + weight_decay * p32
-        return m_new, v_new, u
+        return (m_new.astype(m.dtype), v_new.astype(v.dtype),
+                u.astype(p.dtype))
     kernel = functools.partial(
         _lamb_phase1_kernel, eps=eps, weight_decay=weight_decay)
     m2, n = _to2d(m)
@@ -429,7 +430,12 @@ def lamb_phase1_flat(m, v, g, p, clip_ratio, step, *, beta1, beta2, eps,
         grid=(grid,),
         in_specs=[spec, spec, spec, spec, sspec],
         out_specs=[spec, spec, spec],
-        out_shape=[jax.ShapeDtypeStruct(m2.shape, jnp.float32)] * 3,
+        # m/v aliased in place (dtypes preserved); u rides in the
+        # master dtype so a bf16-state LAMB halves the u write + the
+        # norm-pass and phase-2 reads (≡ the 1.3B Adam bf16-state point)
+        out_shape=[jax.ShapeDtypeStruct(m2.shape, m2.dtype),
+                   jax.ShapeDtypeStruct(v2.shape, v2.dtype),
+                   jax.ShapeDtypeStruct(p2.shape, p2.dtype)],
         input_output_aliases={0: 0, 1: 1},
         interpret=pallas_interpret(),
     )(m2, v2, g2, p2, scalars)

@@ -116,9 +116,14 @@ class DistributedFusedAdam(_ShardedFlat):
                  betas=(0.9, 0.999), eps=1e-8, adam_w_mode=True,
                  weight_decay=0.0, axis_name: str = DP_AXIS,
                  grad_sync_dtype=jnp.float32, param_sync_dtype=None,
-                 n_buckets: int = 1,
+                 n_buckets: int = 1, master_dtype=jnp.float32,
                  use_pallas: Optional[bool] = None):
-        """n_buckets > 1 splits the flat buffer into contiguous
+        """master_dtype=bf16 shards bf16 p/m/v state (in-kernel math
+        stays fp32) — the ZeRO counterpart of FusedAdam's bf16-state
+        dial; halves per-rank state memory AND the update-pass HBM
+        traffic.
+
+        n_buckets > 1 splits the flat buffer into contiguous
         leaf-group buckets, each reduce-scattered INDEPENDENTLY: a
         bucket's collective depends only on its own leaves' grads, so
         XLA's scheduler can start it while backward still computes the
@@ -138,13 +143,16 @@ class DistributedFusedAdam(_ShardedFlat):
         self.grad_sync_dtype = grad_sync_dtype
         self.param_sync_dtype = param_sync_dtype
         self.n_buckets = n_buckets
+        self.master_dtype = master_dtype
         self.use_pallas = use_pallas
         self.spec: Optional[F.FlatSpec] = None
         self.padded_total = None
 
     def _bucket_flats(self, tree, dtype):
         leaves = jax.tree_util.tree_leaves(tree)
-        return [F.flatten(leaves[a:b], dtype,
+        # align must match _make_spec/_flatten (the ONE-layout rule) —
+        # a lane-aligned subclass would otherwise shift bucket offsets
+        return [F.flatten(leaves[a:b], dtype, align=self._ALIGN,
                           pad_to=self.num_shards * K.FLAT_TILE)
                 for a, b in self._ranges]
 
@@ -153,9 +161,9 @@ class DistributedFusedAdam(_ShardedFlat):
         leaves = jax.tree_util.tree_leaves(params)
         sizes = [int(np.prod(l.shape)) if l.shape else 1 for l in leaves]
         self._ranges = _bucket_ranges(sizes, self.n_buckets)
-        self.bucket_specs = [F.make_spec(leaves[a:b])
+        self.bucket_specs = [F.make_spec(leaves[a:b], align=self._ALIGN)
                              for a, b in self._ranges]
-        flats = self._bucket_flats(params, jnp.float32)
+        flats = self._bucket_flats(params, self.master_dtype)
         self._bucket_padded = [f.shape[0] for f in flats]
         self.padded_total = sum(self._bucket_padded)
         rank = lax.axis_index(self.axis_name)
@@ -250,6 +258,7 @@ class DistributedFusedLAMB(_ShardedFlat):
                  betas=(0.9, 0.999), eps=1e-6, weight_decay=0.01,
                  max_grad_norm=1.0, axis_name: str = DP_AXIS,
                  grad_sync_dtype=jnp.float32, param_sync_dtype=None,
+                 master_dtype=jnp.float32,
                  use_pallas: Optional[bool] = None):
         self.num_shards = num_shards
         self.lr = lr
@@ -261,13 +270,14 @@ class DistributedFusedLAMB(_ShardedFlat):
         self.axis_name = axis_name
         self.grad_sync_dtype = grad_sync_dtype
         self.param_sync_dtype = param_sync_dtype
+        self.master_dtype = master_dtype
         self.use_pallas = use_pallas
         self.spec = None
         self.padded_total = None
 
     def init(self, params):
         self._make_spec(params)
-        flat = self._flatten(params)
+        flat = self._flatten(params, self.master_dtype)
         self.padded_total = flat.shape[0]
         shard_size = self.padded_total // self.num_shards
         rank = lax.axis_index(self.axis_name)

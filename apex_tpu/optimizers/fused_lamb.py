@@ -35,7 +35,12 @@ class FusedLAMB(F.FlatCheckpointMixin):
                  eps=1e-6, weight_decay=0.01, amsgrad=False,
                  adam_w_mode=True, grad_averaging=True,
                  max_grad_norm=1.0, use_nvlamb=False,
+                 master_dtype=jnp.float32,
                  use_pallas: Optional[bool] = None):
+        """master_dtype=bf16 keeps p/m/v/u in bf16 — halves the LAMB
+        pass's HBM traffic (the dominant cost at BERT-Large scale; all
+        in-kernel math stays fp32) at ~8-bit state precision, the same
+        dial as FusedAdam's 1.3B bf16-state point (docs/PERF.md)."""
         if amsgrad:
             raise RuntimeError("FusedLAMB does not support the AMSGrad variant.")
         self.lr = lr
@@ -47,12 +52,13 @@ class FusedLAMB(F.FlatCheckpointMixin):
         self.grad_averaging = grad_averaging
         self.max_grad_norm = max_grad_norm
         self.use_nvlamb = use_nvlamb
+        self.master_dtype = master_dtype
         self.use_pallas = use_pallas
         self.spec = None
 
     def init(self, params) -> FusedLAMBState:
         self.spec = F.make_spec(params, align=K._LANES)
-        flat = F.flatten(params, jnp.float32, pad_to=K.FLAT_TILE,
+        flat = F.flatten(params, self.master_dtype, pad_to=K.FLAT_TILE,
                          align=K._LANES)
         zeros = jnp.zeros_like(flat)
         return FusedLAMBState(step=jnp.zeros((), jnp.int32), params=flat,

@@ -51,12 +51,11 @@ from jax import lax
 
 from apex_tpu.ops._common import use_pallas
 from apex_tpu.ops.flash_attention import (
+    _NEG_INF,
     _bwd_impl,
     _fwd_impl,
     _pick_block,
 )
-
-_NEG_INF = -1e30
 
 
 # ------------------------- per-chunk blockwise attention ---------------------

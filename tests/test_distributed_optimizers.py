@@ -419,3 +419,39 @@ def test_dist_adam_bucketed_reduce_scatters_interleavable():
     assert first_rs < last_dot, (
         "all reduce-scatters sit after the last backward dot — "
         "no overlap is possible")
+
+
+def test_dist_adam_bf16_master_state():
+    """ZeRO-2 with bf16 master state: shard dtype is bf16 (half the
+    per-rank state memory) and updates track the fp32-state run."""
+    mesh = M.initialize_model_parallel()
+    params = _params(jax.random.PRNGKey(0))
+    base = _params(jax.random.PRNGKey(1))
+
+    def run(dt):
+        opt = DistributedFusedAdam(num_shards=DP, lr=1e-2,
+                                   master_dtype=dt, use_pallas=False)
+        sspec = DistributedFusedAdamState(P(), P("dp"), P("dp"), P("dp"))
+        state = jax.jit(shard_map(opt.init, mesh=mesh, in_specs=(P(),),
+                                  out_specs=sspec, check_vma=False))(params)
+
+        def local_step(state, g):
+            return opt.step(state, g)
+
+        step = jax.jit(shard_map(local_step, mesh=mesh,
+                                 in_specs=(sspec, P()),
+                                 out_specs=(P(), sspec), check_vma=False))
+        p = None
+        for _ in range(3):
+            p, state = step(state, base)
+        return p, state
+
+    p32, _ = run(jnp.float32)
+    p16, st16 = run(jnp.bfloat16)
+    assert st16.params_shard.dtype == jnp.bfloat16
+    assert st16.exp_avg.dtype == jnp.bfloat16
+    for a, e in zip(jax.tree_util.tree_leaves(p16),
+                    jax.tree_util.tree_leaves(p32)):
+        np.testing.assert_allclose(np.asarray(a, np.float32),
+                                   np.asarray(e, np.float32),
+                                   rtol=2e-2, atol=2e-2)

@@ -368,5 +368,6 @@ def test_flash_in_kernel_dropout_mask_consistency():
 
     # keep-rate statistic ~ 1 - rate
     p_nodrop = np.asarray(_flash(
-        qq, kk, vv, None, None, None, 0.18, True, 0.0, None, None, seed))
+        qq, kk, vv, None, None, None, 0.18, True, 0.0, None, None,
+        False, seed))
     assert not np.allclose(o1, p_nodrop)

@@ -243,3 +243,32 @@ def test_master_dtype_bf16_trains():
         g = jax.grad(loss_fn)(p)
         p, s2 = opt2.step(s2, g)
     assert float(loss_fn(p)) < l0 * 0.5
+
+
+def test_fused_lamb_bf16_master_tracks_fp32():
+    """bf16-state LAMB (master_dtype) must track the fp32-state update
+    to bf16 resolution — the BERT-Large HBM-traffic dial (round 4)."""
+    import jax
+    from apex_tpu.optimizers.fused_lamb import FusedLAMB
+    params = {"w": jax.random.normal(jax.random.PRNGKey(0), (256, 128)),
+              "b": jnp.zeros((128,))}
+    grads = jax.tree_util.tree_map(
+        lambda x: 0.01 * jax.random.normal(jax.random.PRNGKey(1),
+                                           x.shape), params)
+
+    def run(dt):
+        opt = FusedLAMB(lr=1e-2, weight_decay=0.01, master_dtype=dt,
+                        use_pallas=False)
+        state = opt.init(params)
+        p = None
+        for _ in range(5):
+            p, state = opt.step(state, grads)
+        return p
+
+    p32 = run(jnp.float32)
+    p16 = run(jnp.bfloat16)
+    for a, e in zip(jax.tree_util.tree_leaves(p16),
+                    jax.tree_util.tree_leaves(p32)):
+        np.testing.assert_allclose(np.asarray(a, np.float32),
+                                   np.asarray(e, np.float32),
+                                   rtol=2e-2, atol=2e-2)
