@@ -22,7 +22,12 @@ framework long context is first-class:
     `lax.switch` branch that touches no scores), not masked: a causal
     ring costs ~half the FLOPs of the full ring;
   - segment ids rotate with their KV chunk, so packed-varlen batches
-    work across the ring exactly as they do in-kernel.
+    work across the ring exactly as they do in-kernel;
+  - layout="zigzag" (with `zigzag_shard`/`zigzag_unshard`) balances
+    the causal load: device r owns the half-chunk pair (r, 2n-1-r),
+    every device runs exactly two half-computes per step, and the
+    causal ring's wall-clock HALVES vs the contiguous layout (whose
+    last rank computes at every step).
 
   Peak per-device memory: O(s_local · d) tensors + one (block × block)
   score tile — global sequence length scales linearly with ring size.
@@ -178,6 +183,13 @@ def _merge(o_acc, lse_acc, o_c, lse_c):
     return o, m + jnp.log(wsum)
 
 
+def _int_zero(x):
+    """float0 cotangent for integer (segment-id) primals — the one
+    convention both ring variants share."""
+    return (None if x is None
+            else np.zeros(x.shape, dtype=jax.dtypes.float0))
+
+
 def _rotate(axis_name, n, tree):
     perm = [(r, (r + 1) % n) for r in range(n)]
     return jax.tree_util.tree_map(
@@ -299,16 +311,222 @@ def _ring_vjp_bwd(axis_name, causal, scale, block_q, block_k, pallas_path,
     carry0 = (jnp.zeros(q.shape, jnp.float32), k, v, kseg0,
               zero_kd, zero_kd)
     (dq, _, _, _, dk, dv), _ = lax.scan(step, carry0, jnp.arange(n))
-
-    def _int_zero(x):
-        return (None if x is None
-                else np.zeros(x.shape, dtype=jax.dtypes.float0))
-
     return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype),
             _int_zero(q_seg), _int_zero(kv_seg))
 
 
 _ring.defvjp(_ring_vjp_fwd, _ring_vjp_bwd)
+
+
+# ------------------- zigzag ring (load-balanced causal) ---------------------
+#
+# The contiguous causal ring SKIPS above-diagonal chunks, which halves
+# total FLOPs but not the critical path: rank n-1 computes at every one
+# of the n steps while rank 0 computes once.  Zigzag sharding fixes the
+# balance: split the global sequence into 2n half-chunks and give
+# device r the PAIR (r, 2n-1-r) — one early half ("a") and one late
+# half ("b").  Visiting kv from src carries halves (c=src, d=2n-1-src);
+# the causal block structure then decomposes per step into
+#   (a,c): skip if src>r, diag if src==r, full if src<r
+#   (a,d): always skip          (d ≥ n > a — kv strictly later)
+#   (b,c): always full          (c ≤ n-1 < n ≤ b)
+#   (b,d): skip if src<r, diag if src==r, full if src>r
+# so EVERY device runs exactly two half-computes per step (three on its
+# single diagonal step): per-step work is uniform across ranks and the
+# causal ring's wall-clock halves vs the contiguous layout.
+
+def _zigzag_perm(n, seq_len):
+    """Global positions in zigzag order: device r's contiguous shard is
+    global half-chunks (r, 2n-1-r)."""
+    if seq_len % (2 * n):
+        raise ValueError(
+            f"zigzag needs seq_len % (2*n) == 0, got {seq_len} % {2 * n}")
+    c = seq_len // (2 * n)
+    return np.concatenate([
+        np.r_[r * c:(r + 1) * c, (2 * n - 1 - r) * c:(2 * n - r) * c]
+        for r in range(n)])
+
+
+def zigzag_shard(x, n, axis=2):
+    """Reorder a GLOBAL sequence axis so a contiguous n-way shard_map
+    split gives device r the zigzag pair (r, 2n-1-r).  seq % 2n == 0."""
+    return jnp.take(x, jnp.asarray(_zigzag_perm(n, x.shape[axis])),
+                    axis=axis)
+
+
+def zigzag_unshard(x, n, axis=2):
+    """Inverse of zigzag_shard."""
+    perm = _zigzag_perm(n, x.shape[axis])
+    inv = np.empty_like(perm)
+    inv[perm] = np.arange(perm.shape[0])
+    return jnp.take(x, jnp.asarray(inv), axis=axis)
+
+
+def _halves(x, half, axis=2):
+    if x is None:
+        return None, None
+    lo = lax.slice_in_dim(x, 0, half, axis=axis)
+    hi = lax.slice_in_dim(x, half, x.shape[axis], axis=axis)
+    return lo, hi
+
+
+def _ring_fwd_zigzag(q, k, v, q_seg, kv_seg, axis_name, scale,
+                     block_q, block_k, pallas_path):
+    b, h, s, d = q.shape
+    half = s // 2
+    n = lax.axis_size(axis_name)
+    rank = lax.axis_index(axis_name)
+    has_seg = q_seg is not None
+    q_a, q_b = _halves(q, half)
+    qs_a, qs_b = _halves(q_seg, half, axis=1)
+
+    def attend(qh, qsh, kh, vh, ksh, causal_flag):
+        return _chunk_fwd(qh, kh, vh, scale, causal_flag, qsh, ksh,
+                          block_q, block_k, pallas_path)
+
+    def gated(idx, o_acc, l_acc, qh, qsh, kh, vh, ksh):
+        """idx: 0 skip, 1 diag (causal), 2 full."""
+        def do_skip(_):
+            return o_acc, l_acc
+
+        def do_diag(_):
+            return _merge(o_acc, l_acc, *attend(qh, qsh, kh, vh, ksh,
+                                                True))
+
+        def do_full(_):
+            return _merge(o_acc, l_acc, *attend(qh, qsh, kh, vh, ksh,
+                                                False))
+
+        return lax.switch(idx, (do_skip, do_diag, do_full), None)
+
+    def step(carry, i):
+        o_a, l_a, o_b, l_b, k_c, v_c, kseg_c = carry
+        src = (rank - i) % n
+        k_lo, k_hi = _halves(k_c, half)
+        v_lo, v_hi = _halves(v_c, half)
+        ks_lo, ks_hi = _halves(kseg_c if has_seg else None, half, axis=1)
+        # (b, c): unconditionally full
+        o_b, l_b = _merge(o_b, l_b,
+                          *attend(q_b, qs_b, k_lo, v_lo, ks_lo, False))
+        # (a, c)
+        idx_ac = jnp.where(src > rank, 0, jnp.where(src == rank, 1, 2))
+        o_a, l_a = gated(idx_ac, o_a, l_a, q_a, qs_a, k_lo, v_lo, ks_lo)
+        # (b, d)
+        idx_bd = jnp.where(src < rank, 0, jnp.where(src == rank, 1, 2))
+        o_b, l_b = gated(idx_bd, o_b, l_b, q_b, qs_b, k_hi, v_hi, ks_hi)
+        k_c, v_c = _rotate(axis_name, n, (k_c, v_c))
+        if has_seg:
+            kseg_c = _rotate(axis_name, n, kseg_c)
+        return (o_a, l_a, o_b, l_b, k_c, v_c, kseg_c), None
+
+    o0 = jnp.zeros((b, h, half, d), jnp.float32)
+    l0 = jnp.full((b, h, half), _NEG_INF, jnp.float32)
+    kseg0 = kv_seg if has_seg else jnp.zeros((), jnp.int32)
+    (o_a, l_a, o_b, l_b, *_), _ = lax.scan(
+        step, (o0, l0, o0, l0, k, v, kseg0), jnp.arange(n))
+    o = jnp.concatenate([o_a, o_b], axis=2).astype(q.dtype)
+    lse = jnp.concatenate([l_a, l_b], axis=2)
+    return o, lse
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
+def _ring_zz(q, k, v, q_seg, kv_seg, axis_name, scale, block_q,
+             block_k, pallas_path):
+    o, _ = _ring_fwd_zigzag(q, k, v, q_seg, kv_seg, axis_name, scale,
+                            block_q, block_k, pallas_path)
+    return o
+
+
+def _ring_zz_vjp_fwd(q, k, v, q_seg, kv_seg, axis_name, scale, block_q,
+                     block_k, pallas_path):
+    o, lse = _ring_fwd_zigzag(q, k, v, q_seg, kv_seg, axis_name, scale,
+                              block_q, block_k, pallas_path)
+    return o, (q, k, v, q_seg, kv_seg, o, lse)
+
+
+def _ring_zz_vjp_bwd(axis_name, scale, block_q, block_k, pallas_path,
+                     res, do):
+    q, k, v, q_seg, kv_seg, o, lse = res
+    half = q.shape[2] // 2
+    n = lax.axis_size(axis_name)
+    rank = lax.axis_index(axis_name)
+    has_seg = q_seg is not None
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=-1)
+    q_a, q_b = _halves(q, half)
+    o_a, o_b = _halves(o, half)
+    do_a, do_b = _halves(do, half)
+    qs_a, qs_b = _halves(q_seg, half, axis=1)
+    lse_a, lse_b = _halves(lse, half, axis=2)
+    d_a, d_b = _halves(delta, half, axis=2)
+    # q and kv shards share (b, h, half, d) — one zero serves the skip
+    # branch's dq, dk, and dv partials
+    zero_half = jnp.zeros(q_a.shape, jnp.float32)
+
+    def partials(qh, qsh, oh, lh, dh, doh, kh, vh, ksh, causal_flag):
+        return _chunk_bwd(qh, kh, vh, oh, lh, dh, doh, scale,
+                          causal_flag, qsh, ksh, block_q, block_k,
+                          pallas_path)
+
+    def gated(idx, *args):
+        def do_skip(_):
+            return zero_half, zero_half, zero_half
+
+        def do_diag(_):
+            return partials(*args, True)
+
+        def do_full(_):
+            return partials(*args, False)
+
+        return lax.switch(idx, (do_skip, do_diag, do_full), None)
+
+    def step(carry, i):
+        (dq_a, dq_b, k_c, v_c, kseg_c,
+         dk_lo, dk_hi, dv_lo, dv_hi) = carry
+        src = (rank - i) % n
+        k_lo, k_hi = _halves(k_c, half)
+        v_lo, v_hi = _halves(v_c, half)
+        ks_lo, ks_hi = _halves(kseg_c if has_seg else None, half, axis=1)
+        # (b, c): unconditionally full
+        p_q, p_k, p_v = partials(q_b, qs_b, o_b, lse_b, d_b, do_b,
+                                 k_lo, v_lo, ks_lo, False)
+        dq_b = dq_b + p_q
+        dk_lo = dk_lo + p_k
+        dv_lo = dv_lo + p_v
+        # (a, c)
+        idx_ac = jnp.where(src > rank, 0, jnp.where(src == rank, 1, 2))
+        p_q, p_k, p_v = gated(idx_ac, q_a, qs_a, o_a, lse_a, d_a, do_a,
+                              k_lo, v_lo, ks_lo)
+        dq_a = dq_a + p_q
+        dk_lo = dk_lo + p_k
+        dv_lo = dv_lo + p_v
+        # (b, d)
+        idx_bd = jnp.where(src < rank, 0, jnp.where(src == rank, 1, 2))
+        p_q, p_k, p_v = gated(idx_bd, q_b, qs_b, o_b, lse_b, d_b, do_b,
+                              k_hi, v_hi, ks_hi)
+        dq_b = dq_b + p_q
+        dk_hi = dk_hi + p_k
+        dv_hi = dv_hi + p_v
+        (k_c, v_c, dk_lo, dk_hi, dv_lo, dv_hi) = _rotate(
+            axis_name, n, (k_c, v_c, dk_lo, dk_hi, dv_lo, dv_hi))
+        if has_seg:
+            kseg_c = _rotate(axis_name, n, kseg_c)
+        return (dq_a, dq_b, k_c, v_c, kseg_c,
+                dk_lo, dk_hi, dv_lo, dv_hi), None
+
+    kseg0 = kv_seg if has_seg else jnp.zeros((), jnp.int32)
+    carry0 = (zero_half, zero_half, k, v, kseg0,
+              zero_half, zero_half, zero_half, zero_half)
+    (dq_a, dq_b, _, _, _, dk_lo, dk_hi, dv_lo, dv_hi), _ = lax.scan(
+        step, carry0, jnp.arange(n))
+    dq = jnp.concatenate([dq_a, dq_b], axis=2)
+    dk = jnp.concatenate([dk_lo, dk_hi], axis=2)
+    dv = jnp.concatenate([dv_lo, dv_hi], axis=2)
+    return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype),
+            _int_zero(q_seg), _int_zero(kv_seg))
+
+
+_ring_zz.defvjp(_ring_zz_vjp_fwd, _ring_zz_vjp_bwd)
 
 
 # -------------------------------- public API --------------------------------
@@ -317,6 +535,7 @@ def ring_attention(q, k, v, axis_name: str, *, causal: bool = False,
                    softmax_scale: Optional[float] = None,
                    segment_ids=None, q_segment_ids=None,
                    kv_segment_ids=None,
+                   layout: str = "contiguous",
                    block_q: Optional[int] = None,
                    block_k: Optional[int] = None,
                    use_pallas_override: Optional[bool] = None):
@@ -327,7 +546,17 @@ def ring_attention(q, k, v, axis_name: str, *, causal: bool = False,
     ids are (b, s_local) int per shard, global semantics (tokens attend
     only within equal ids, across shards).  Returns the local output
     shard (b, h, s_local, d).
+
+    layout="zigzag" (causal only): device r holds the global half-chunk
+    PAIR (r, 2n-1-r) — shard with `zigzag_shard` (and undo with
+    `zigzag_unshard`).  Every device then runs exactly two half-chunk
+    computes per ring step, so the causal ring's wall-clock HALVES vs
+    the contiguous layout, whose last rank computes at every step (see
+    the zigzag section above).  Non-causal attention has no positional
+    structure to balance — use the default layout.
     """
+    if layout not in ("contiguous", "zigzag"):
+        raise ValueError(f"unknown layout {layout!r}")
     d = q.shape[-1]
     scale = (softmax_scale if softmax_scale is not None
              else 1.0 / math.sqrt(d))
@@ -347,6 +576,13 @@ def ring_attention(q, k, v, axis_name: str, *, causal: bool = False,
             raise ValueError(
                 f"segment id shapes {q_segment_ids.shape}/"
                 f"{kv_segment_ids.shape} != ({b}, {s})")
+    if layout == "zigzag" and causal:
+        if s % 2:
+            raise ValueError("zigzag needs an even local sequence")
+        pallas_path = bool(use_pallas(use_pallas_override)
+                           and _pick_block(s // 2))
+        return _ring_zz(q, k, v, q_segment_ids, kv_segment_ids,
+                        axis_name, scale, block_q, block_k, pallas_path)
     pallas_path = bool(use_pallas(use_pallas_override)
                        and _pick_block(s))
     return _ring(q, k, v, q_segment_ids, kv_segment_ids, axis_name,
