@@ -591,12 +591,15 @@ def ring_attention(q, k, v, axis_name: str, *, causal: bool = False,
 
 def ulysses_attention(q, k, v, axis_name: str, *, causal: bool = False,
                       softmax_scale: Optional[float] = None,
+                      segment_ids=None,
                       use_flash: bool = True):
     """All-to-all (DeepSpeed-Ulysses-style) sequence parallelism.
 
     Inputs are seq-sharded (b, h, s_local, d) with h % axis_size == 0;
     internally heads are scattered so each device sees the FULL sequence
     for h/axis heads, runs (flash) attention, and scatters back.
+    segment_ids: (b, s_local) int per shard, global semantics — gathered
+    to the full sequence with the heads (packed-varlen works here too).
     """
     n = lax.axis_size(axis_name)
     b, h, s_local, d = q.shape
@@ -613,12 +616,19 @@ def ulysses_attention(q, k, v, axis_name: str, *, causal: bool = False,
                               tiled=True)
 
     qg, kg, vg = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+    seg_g = None
+    if segment_ids is not None:
+        # every device needs the FULL (b, s_global) ids — one gather
+        seg_g = lax.all_gather(jnp.asarray(segment_ids, jnp.int32),
+                               axis_name, axis=1, tiled=True)
     if use_flash:
         from apex_tpu.ops.flash_attention import flash_attention
         og = flash_attention(qg, kg, vg, causal=causal,
-                             softmax_scale=softmax_scale)
+                             softmax_scale=softmax_scale,
+                             segment_ids=seg_g)
     else:
         from apex_tpu.ops.flash_attention import attention_reference
         og = attention_reference(qg, kg, vg, causal=causal,
-                                 softmax_scale=softmax_scale)
+                                 softmax_scale=softmax_scale,
+                                 q_segment_ids=seg_g, kv_segment_ids=seg_g)
     return heads_to_seq(og)

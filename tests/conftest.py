@@ -82,6 +82,7 @@ L1 = {
     "test_examples.py::test_dcgan_runs[O1]",
     "test_examples.py::test_dcgan_runs[O2]",
     "test_examples.py::test_simple_distributed_runs",
+    "test_examples.py::test_long_context_training_runs",
     "test_bert_minimal.py::test_bert_loss_consistent_across_tp",
     "test_bert_minimal.py::test_bert_flash_vs_dense_attention_parity",
     "test_bert_minimal.py::test_bert_pad_mask",

@@ -331,3 +331,23 @@ def test_zigzag_ring_pallas_path():
     want = attention_reference(q, k, v, causal=True)
     np.testing.assert_allclose(np.asarray(zigzag_unshard(o, N)),
                                np.asarray(want), rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ulysses_segment_ids(causal):
+    mesh = M.initialize_model_parallel(tensor_model_parallel_size=N)
+    q, k, v = _qkv(2, 8, 64, 16, seed=23)
+    seg = (jnp.arange(64) // 20)[None, :].repeat(2, axis=0)
+
+    f = shard_map(
+        lambda q, k, v, s: ulysses_attention(q, k, v, "tp", causal=causal,
+                                             segment_ids=s,
+                                             use_flash=False),
+        mesh=mesh,
+        in_specs=(P(None, None, "tp"),) * 3 + (P(None, "tp"),),
+        out_specs=P(None, None, "tp"), check_vma=False)
+    got = f(q, k, v, seg)
+    want = attention_reference(q, k, v, causal=causal,
+                               q_segment_ids=seg, kv_segment_ids=seg)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-4, atol=1e-4)
