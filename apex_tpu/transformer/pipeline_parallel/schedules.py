@@ -24,7 +24,6 @@ loop cycles microbatches through chunk 0 of all stages, then chunk 1,
 
 from __future__ import annotations
 
-import functools
 from typing import Callable, Optional
 
 import jax
