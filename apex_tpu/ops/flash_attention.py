@@ -602,8 +602,15 @@ def _head_row_spec(nq, bq):
 
 def _bwd_impl(q, k, v, o, lse, do, scale, causal, dropout_rate=0.0,
               seed=None, block_q=None, block_k=None, bias=None,
-              q_seg=None, kv_seg=None, want_dbias=False):
-    """Returns (dq, dk, dv, dbias) — dbias is None unless want_dbias."""
+              q_seg=None, kv_seg=None, want_dbias=False,
+              grad_dtype=None):
+    """Returns (dq, dk, dv, dbias) — dbias is None unless want_dbias.
+
+    grad_dtype overrides the dq/dk/dv output dtype (default: the input
+    dtypes).  The ring-attention backward passes fp32 so per-ring-step
+    partials accumulate at full precision instead of being rounded to
+    bf16 once per ring hop (the kernels accumulate in fp32 scratch
+    either way; this only moves the final rounding)."""
     b, h, sq, d = q.shape
     sk = k.shape[2]
     bias_kind = _bias_kind(bias, sk)
@@ -624,6 +631,9 @@ def _bwd_impl(q, k, v, o, lse, do, scale, causal, dropout_rate=0.0,
     static = dict(scale=scale, causal=causal, bq=bq, bk=bk,
                   dropout_rate=dropout_rate, bias_kind=bias_kind,
                   has_seg=has_seg)
+    dq_dt = grad_dtype or q.dtype
+    dk_dt = grad_dtype or k.dtype
+    dv_dt = grad_dtype or v.dtype
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
                     axis=-1)  # (b,h,sq)
     args = [_flatten_bh(q), _flatten_bh(k), _flatten_bh(v),
@@ -653,9 +663,9 @@ def _bwd_impl(q, k, v, o, lse, do, scale, causal, dropout_rate=0.0,
     # fits VMEM comfortably; two-kernel fallback for long context
     if sk * d <= _FUSED_BWD_CAP and not dbias_sk:
         out_specs = [qspec, kspec, kspec]
-        out_shape = [jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
-                     jax.ShapeDtypeStruct((bh, sk, d), k.dtype),
-                     jax.ShapeDtypeStruct((bh, sk, d), v.dtype)]
+        out_shape = [jax.ShapeDtypeStruct((bh, sq, d), dq_dt),
+                     jax.ShapeDtypeStruct((bh, sk, d), dk_dt),
+                     jax.ShapeDtypeStruct((bh, sk, d), dv_dt)]
         if dbias_full:
             out_specs.append(pl.BlockSpec((1, bk, bq),
                                           lambda i, j, t: (i, t, j)))
@@ -687,7 +697,7 @@ def _bwd_impl(q, k, v, o, lse, do, scale, causal, dropout_rate=0.0,
                 dv.reshape(v.shape), dbias)
 
     dq_specs = [qspec]
-    dq_shape = [jax.ShapeDtypeStruct((bh, sq, d), q.dtype)]
+    dq_shape = [jax.ShapeDtypeStruct((bh, sq, d), dq_dt)]
     if dbias_full:
         dq_specs.append(pl.BlockSpec((1, bk, bq),
                                      lambda i, j, t: (i, t, j)))
@@ -719,8 +729,8 @@ def _bwd_impl(q, k, v, o, lse, do, scale, causal, dropout_rate=0.0,
         h, nq, bq, nk, bk, bias_kind, nb, nh, has_seg,
         jt_from_args=lambda t, j: (j, t))
     dkv_specs = [kspec2, kspec2]
-    dkv_shape = [jax.ShapeDtypeStruct((bh, sk, d), k.dtype),
-                 jax.ShapeDtypeStruct((bh, sk, d), v.dtype)]
+    dkv_shape = [jax.ShapeDtypeStruct((bh, sk, d), dk_dt),
+                 jax.ShapeDtypeStruct((bh, sk, d), dv_dt)]
     dkv_scratch = [pltpu.VMEM((bk, d), jnp.float32),
                    pltpu.VMEM((bk, d), jnp.float32)]
     if dbias_sk:

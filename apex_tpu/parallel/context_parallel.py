@@ -160,11 +160,14 @@ def _chunk_fwd(q, k, v, scale, causal, q_seg, kv_seg, block_q, block_k,
 def _chunk_bwd(q, k, v, o, lse, delta, do, scale, causal, q_seg, kv_seg,
                block_q, block_k, pallas_path):
     if pallas_path:
+        # fp32 partials straight from the kernel: per-ring-step grads
+        # accumulate across hops at full precision and round to the
+        # input dtype ONCE at the end (ADVICE r4 — bf16-per-hop rounding
+        # degraded with ring size)
         dq, dk, dv, _ = _bwd_impl(q, k, v, o, lse, do, scale, causal,
                                   0.0, None, block_q, block_k, None,
-                                  q_seg, kv_seg)
-        return (dq.astype(jnp.float32), dk.astype(jnp.float32),
-                dv.astype(jnp.float32))
+                                  q_seg, kv_seg, grad_dtype=jnp.float32)
+        return dq, dk, dv
     return _chunk_bwd_jnp(q, k, v, do, lse, delta, scale, causal,
                           q_seg, kv_seg, block_k)
 
@@ -576,7 +579,12 @@ def ring_attention(q, k, v, axis_name: str, *, causal: bool = False,
             raise ValueError(
                 f"segment id shapes {q_segment_ids.shape}/"
                 f"{kv_segment_ids.shape} != ({b}, {s})")
-    if layout == "zigzag" and causal:
+    if layout == "zigzag":
+        if not causal:
+            raise ValueError(
+                "layout='zigzag' is causal-only: non-causal attention "
+                "has no positional imbalance to fix — use the default "
+                "contiguous layout (results are identical)")
         if s % 2:
             raise ValueError("zigzag needs an even local sequence")
         pallas_path = bool(use_pallas(use_pallas_override)
@@ -592,7 +600,8 @@ def ring_attention(q, k, v, axis_name: str, *, causal: bool = False,
 def ulysses_attention(q, k, v, axis_name: str, *, causal: bool = False,
                       softmax_scale: Optional[float] = None,
                       segment_ids=None,
-                      use_flash: bool = True):
+                      use_flash: bool = True,
+                      use_pallas_override: Optional[bool] = None):
     """All-to-all (DeepSpeed-Ulysses-style) sequence parallelism.
 
     Inputs are seq-sharded (b, h, s_local, d) with h % axis_size == 0;
@@ -625,7 +634,8 @@ def ulysses_attention(q, k, v, axis_name: str, *, causal: bool = False,
         from apex_tpu.ops.flash_attention import flash_attention
         og = flash_attention(qg, kg, vg, causal=causal,
                              softmax_scale=softmax_scale,
-                             segment_ids=seg_g)
+                             segment_ids=seg_g,
+                             use_pallas_override=use_pallas_override)
     else:
         from apex_tpu.ops.flash_attention import attention_reference
         og = attention_reference(qg, kg, vg, causal=causal,

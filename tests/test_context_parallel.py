@@ -333,8 +333,14 @@ def test_zigzag_ring_pallas_path():
                                np.asarray(want), rtol=1e-4, atol=1e-4)
 
 
+@pytest.mark.parametrize("use_flash", [False, True])
 @pytest.mark.parametrize("causal", [False, True])
-def test_ulysses_segment_ids(causal):
+def test_ulysses_segment_ids(causal, use_flash):
+    # use_flash=True exercises the all_gather + flash(segment_ids=...)
+    # branch; use_pallas_override=True forces the interpret-mode Pallas
+    # kernel on CPU (without it flash_attention silently takes the
+    # dense fallback off-TPU and the test compares the reference with
+    # itself), ADVICE r4
     mesh = M.initialize_model_parallel(tensor_model_parallel_size=N)
     q, k, v = _qkv(2, 8, 64, 16, seed=23)
     seg = (jnp.arange(64) // 20)[None, :].repeat(2, axis=0)
@@ -342,7 +348,8 @@ def test_ulysses_segment_ids(causal):
     f = shard_map(
         lambda q, k, v, s: ulysses_attention(q, k, v, "tp", causal=causal,
                                              segment_ids=s,
-                                             use_flash=False),
+                                             use_flash=use_flash,
+                                             use_pallas_override=use_flash),
         mesh=mesh,
         in_specs=(P(None, None, "tp"),) * 3 + (P(None, "tp"),),
         out_specs=P(None, None, "tp"), check_vma=False)
