@@ -21,6 +21,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from apex_tpu.ops.pooling import max_pool2d
 from apex_tpu.parallel.sync_batchnorm import sync_batch_norm
 
 
@@ -29,6 +30,33 @@ def conv2d(x, w, stride=1, padding="SAME"):
     return lax.conv_general_dilated(
         x, w, window_strides=(stride, stride), padding=padding,
         dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+
+def space_to_depth_2x2(x):
+    """(B, H, W, C) → (B, H/2, W/2, 4C), channel order (u, v, c)."""
+    b, h, w, c = x.shape
+    x = x.reshape(b, h // 2, 2, w // 2, 2, c)
+    x = x.transpose(0, 1, 3, 2, 4, 5)
+    return x.reshape(b, h // 2, w // 2, 4 * c)
+
+
+def _stem_s2d_weights(w7):
+    """Exact rewrite of the (7,7,3,cout) stride-2 stem kernel as a
+    (4,4,12,cout) stride-1 kernel over the 2x2 space-to-depth input.
+
+    With SAME padding (pad_lo=2 at k7 s2; pad_lo=1 at k4 s1):
+      out[i] = Σ_di x[2i + di - 2] · w[di]
+             = Σ_{ka,u} z[i + ka - 1]⟨u⟩ · w[2·ka + u]
+    so w'[ka, kb, (u,v,c), o] = w_pad[2ka+u, 2kb+v, c, o] with w zero-
+    padded from 7 to 8 taps.  The same TPU stem transform as the MLPerf
+    ResNet submissions — the (3-channel, stride-2) conv maps terribly
+    onto the MXU's 128-lane tiles; the s2d form is stride-1 with 4x the
+    channels and identical math.
+    """
+    k, _, cin, cout = w7.shape
+    w_pad = jnp.zeros((8, 8, cin, cout), w7.dtype).at[:k, :k].set(w7)
+    w_pad = w_pad.reshape(4, 2, 4, 2, cin, cout)       # (ka,u,kb,v,c,o)
+    return w_pad.transpose(0, 2, 1, 3, 4, 5).reshape(4, 4, 4 * cin, cout)
 
 
 def _conv_init(key, kh, kw, cin, cout, dtype):
@@ -160,12 +188,25 @@ _CONFIGS = {
 
 class ResNet:
     def __init__(self, arch: str = "resnet50", num_classes: int = 1000,
-                 axis_name: Optional[str] = None, small_input: bool = False):
+                 axis_name: Optional[str] = None, small_input: bool = False,
+                 stem: str = "conv7"):
+        """stem="space_to_depth" computes the SAME function as the
+        default 7x7/s2 stem via a 2x2 space-to-depth input + 4x4/s1
+        conv (see _stem_s2d_weights) — params stay (7,7,3,64), so
+        checkpoints are interchangeable between the two settings."""
+        if stem not in ("conv7", "space_to_depth"):
+            raise ValueError(f"unknown stem {stem!r}")
+        if stem == "space_to_depth" and small_input:
+            raise ValueError(
+                "stem='space_to_depth' rewrites the 7x7/s2 ImageNet "
+                "stem; the small_input (CIFAR) 3x3/s1 stem has no "
+                "stride to fold — use the default stem")
         block_cls, layers = _CONFIGS[arch]
         self.arch = arch
         self.num_classes = num_classes
         self.axis_name = axis_name
         self.small_input = small_input  # CIFAR stand-in: 3x3 stem, no pool
+        self.stem = stem
         self.blocks = []
         cin = 64
         for stage, n in enumerate(layers):
@@ -198,13 +239,24 @@ class ResNet:
         ax = self.axis_name if axis_name == "__unset__" else axis_name
         new_state = {}
         stride = 1 if self.small_input else 2
-        h = conv2d(x, params["conv_stem"], stride=stride)
+        if self.stem == "space_to_depth" and not self.small_input:
+            if x.shape[1] % 2 or x.shape[2] % 2:
+                raise ValueError(
+                    f"stem='space_to_depth' needs even spatial dims, "
+                    f"got {x.shape[1]}x{x.shape[2]} — pad the input or "
+                    "use the default stem (same function)")
+            h = conv2d(space_to_depth_2x2(x),
+                       _stem_s2d_weights(params["conv_stem"]), stride=1)
+        else:
+            h = conv2d(x, params["conv_stem"], stride=stride)
         h, new_state["bn_stem"] = _bn_apply(params["bn_stem"],
                                             state["bn_stem"], h, training, ax)
         h = jnp.maximum(h, 0)
         if not self.small_input:
-            h = lax.reduce_window(h, -jnp.inf, lax.max, (1, 3, 3, 1),
-                                  (1, 2, 2, 1), "SAME")
+            # default (SelectAndScatter) backward: measured faster than
+            # every dense routed reformulation in full-model context on
+            # v5e (ops/pooling.py docstring has the numbers)
+            h = max_pool2d(h, (3, 3), (2, 2), "SAME")
         for i, blk in enumerate(self.blocks):
             h, new_state[f"block{i}"] = blk.apply(
                 params[f"block{i}"], state[f"block{i}"], h, training, ax)

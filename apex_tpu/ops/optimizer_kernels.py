@@ -116,20 +116,14 @@ def _adam_kernel(p_ref, m_ref, v_ref, g_ref, sc_ref,
     v_out[...] = v_new.astype(v_out.dtype)
 
 
-def adam_flat(p, m, v, g, lr, step, *, beta1=0.9, beta2=0.999, eps=1e-8,
-              weight_decay=0.0, adam_w_mode=True, bias_correction=True,
-              inv_scale=1.0, found_inf=False, use_pallas_override=None):
-    """One fused Adam/AdamW step on flat buffers.
-
-    ≡ amp_C.multi_tensor_adam / multi_tensor_adam_capturable
-    (csrc/multi_tensor_adam.cu).  `step` may be traced (on-device step
-    count, ≡ capturable mode's GPU-side `step` tensor).
-    Returns (p, m, v) new buffers (donate inputs under jit).
-    """
+def _adam_fold_scalars(lr, step, beta1, beta2, bias_correction,
+                       inv_scale, found_inf):
+    """The ONE definition of the Adam folded-scalar rows (shared by the
+    uniform and per-tensor-seg variants, which must stay numerically
+    identical).  clamp: at step 0 (reachable only when found_inf skips
+    the very first update, so m=v=0) bc would be 0 and 1/bc inf —
+    inf*0=nan would poison the select-free kernel."""
     step = jnp.asarray(step, jnp.float32)
-    # clamp: at step 0 (reachable only when found_inf skips the very
-    # first update, so m=v=0) bc would be 0 and 1/bc inf — inf*0=nan
-    # would poison the select-free kernel
     bc1 = jnp.maximum(1.0 - jnp.power(jnp.float32(beta1), step), 1e-20)
     bc2 = jnp.maximum(1.0 - jnp.power(jnp.float32(beta2), step), 1e-20)
     one = jnp.float32(1.0)
@@ -137,7 +131,7 @@ def adam_flat(p, m, v, g, lr, step, *, beta1=0.9, beta2=0.999, eps=1e-8,
     # fold overflow-skip + bias correction into broadcast scalars: the
     # kernel then runs select-free and divide-free (one vector divide
     # left) — see _adam_kernel
-    scalars = jnp.stack([
+    return jnp.stack([
         jnp.where(keep, 0.0, jnp.asarray(lr, jnp.float32)),   # lr_eff
         jnp.asarray(inv_scale, jnp.float32),
         jnp.where(keep, one, jnp.float32(beta1)),             # b1e
@@ -148,6 +142,20 @@ def adam_flat(p, m, v, g, lr, step, *, beta1=0.9, beta2=0.999, eps=1e-8,
         one / bc2 if bias_correction else one,                # rbc2
         keep.astype(jnp.float32),                             # found
     ]).reshape(9, 1)
+
+
+def adam_flat(p, m, v, g, lr, step, *, beta1=0.9, beta2=0.999, eps=1e-8,
+              weight_decay=0.0, adam_w_mode=True, bias_correction=True,
+              inv_scale=1.0, found_inf=False, use_pallas_override=None):
+    """One fused Adam/AdamW step on flat buffers.
+
+    ≡ amp_C.multi_tensor_adam / multi_tensor_adam_capturable
+    (csrc/multi_tensor_adam.cu).  `step` may be traced (on-device step
+    count, ≡ capturable mode's GPU-side `step` tensor).
+    Returns (p, m, v) new buffers (donate inputs under jit).
+    """
+    scalars = _adam_fold_scalars(lr, step, beta1, beta2, bias_correction,
+                                 inv_scale, found_inf)
     if not use_pallas(use_pallas_override):
         return _adam_reference(p, m, v, g, scalars, eps,
                                weight_decay, adam_w_mode)
@@ -192,6 +200,132 @@ def _adam_reference(p, m, v, g, scalars, eps, weight_decay, adam_w_mode):
     if adam_w_mode and weight_decay:
         update = update + weight_decay * p32
     p_new = p32 - lr_eff * update
+    return (p_new.astype(p.dtype), m_new.astype(m.dtype),
+            v_new.astype(v.dtype))
+
+
+def _adam_seg_kernel(p_ref, m_ref, v_ref, g_ref, sc_ref, lo_ref, hi_ref,
+                     vals_ref, off_ref, p_out, m_out, v_out, *,
+                     eps, adam_w_mode, npad, R):
+    """_adam_kernel with PER-TENSOR weight decay and lr scale: vals_ref
+    row 0 holds each tensor's weight decay, row 1 its lr multiplier;
+    the per-row pair is rebuilt per block from the static segment row
+    bounds via one one-hot matmul (the lamb_phase2_seg trick) — the
+    (total,) per-element vectors never exist in HBM.
+
+    ≡ the reference's param_groups loop (apex/optimizers/fused_adam.py:
+    156-303), which launches multi_tensor_adam once per group with that
+    group's lr/weight_decay — here one pass covers every group.
+    Padding rows fall outside every bound → wd=0 AND lr scale 0, so the
+    zero-filled tails never move."""
+    i = pl.program_id(0)
+    oh = _block_onehot(lo_ref, hi_ref, off_ref, i, R, npad)
+    # one select-matmul yields both per-row values; HIGHEST keeps the
+    # fp32 hyperparameters exact (default MXU path rounds to bf16)
+    wl = jax.lax.dot_general(oh, vals_ref[0:2, :],
+                             (((1,), (1,)), ((), ())),
+                             precision=jax.lax.Precision.HIGHEST)
+    wd_row, lrs_row = wl[:, 0:1], wl[:, 1:2]
+    g = g_ref[...].astype(jnp.float32)
+    p = p_ref[...].astype(jnp.float32)
+    m = m_ref[...].astype(jnp.float32)
+    v = v_ref[...].astype(jnp.float32)
+    lr_eff = sc_ref[0, 0]
+    inv_scale = sc_ref[1, 0]
+    b1e, c1 = sc_ref[2, 0], sc_ref[3, 0]
+    b2e, c2 = sc_ref[4, 0], sc_ref[5, 0]
+    rbc1, rbc2 = sc_ref[6, 0], sc_ref[7, 0]
+    g = jnp.where(sc_ref[8, 0] > 0.5, 0.0, g * inv_scale)
+    if not adam_w_mode:
+        g = g + wd_row * p
+    m_new = b1e * m + c1 * g
+    v_new = b2e * v + c2 * (g * g)
+    update = (m_new * rbc1) / (jnp.sqrt(v_new * rbc2) + eps)
+    if adam_w_mode:
+        update = update + wd_row * p
+    p_out[...] = (p - lr_eff * lrs_row * update).astype(p_out.dtype)
+    m_out[...] = m_new.astype(m_out.dtype)
+    v_out[...] = v_new.astype(v_out.dtype)
+
+
+def _seg_vals2(wd_values, lr_scale_values, npad):
+    n_seg = wd_values.shape[0]
+    vals = jnp.zeros((8, npad), jnp.float32)
+    vals = vals.at[0, :n_seg].set(wd_values.astype(jnp.float32))
+    vals = vals.at[1, :n_seg].set(lr_scale_values.astype(jnp.float32))
+    return vals
+
+
+def adam_flat_seg(p, m, v, g, lr, step, *, wd_values, lr_scale_values,
+                  spec, row_offset=0, padded_total=None,
+                  beta1=0.9, beta2=0.999, eps=1e-8, adam_w_mode=True,
+                  bias_correction=True, inv_scale=1.0, found_inf=False,
+                  use_pallas_override=None):
+    """adam_flat with per-tensor (weight_decay, lr_scale) vectors — the
+    consumer of get_params_for_weight_decay_optimization's mask.  `spec`
+    must be lane-aligned (FlatSpec(align=128)); `row_offset` is p's
+    global starting row for ZeRO shards (may be traced; `padded_total`
+    is then required for the jnp fallback's segment map)."""
+    scalars = _adam_fold_scalars(lr, step, beta1, beta2, bias_correction,
+                                 inv_scale, found_inf)
+    wd_values = jnp.asarray(wd_values, jnp.float32)
+    lr_scale_values = jnp.asarray(lr_scale_values, jnp.float32)
+    n_seg = wd_values.shape[0]
+    npad = _seg_pad(n_seg)
+    if not (use_pallas(use_pallas_override) and n_seg + 1 < _SEG_CAP
+            and p.shape[0] % FLAT_TILE == 0):
+        rows = p.shape[0] // _LANES
+        total = padded_total if padded_total is not None else p.shape[0]
+        rank = jnp.asarray(row_offset, jnp.int32) // rows
+        seg = shard_segment_ids(spec, rank, rows, total)
+        wd_elem = expand_per_tensor_shard(wd_values, seg)
+        lrs_elem = expand_per_tensor_shard(lr_scale_values, seg)
+        return _adam_seg_reference(p, m, v, g, scalars, eps, adam_w_mode,
+                                   wd_elem, lrs_elem)
+    p2, np_ = _to2d(p)
+    m2, _ = _to2d(m)
+    v2, _ = _to2d(v)
+    g2, _ = _to2d(g)
+    R = _BLOCK_ROWS
+    grid = p2.shape[0] // R
+    lo, hi = _seg_row_bounds(spec, npad)
+    vals = _seg_vals2(wd_values, lr_scale_values, npad)
+    off = jnp.asarray(row_offset, jnp.int32).reshape(1, 1)
+    bspec = pl.BlockSpec((8, npad), lambda i: (0, 0))
+    spec_b = pl.BlockSpec((R, _LANES), lambda i: (i, 0))
+    pn, mn, vn = pl.pallas_call(
+        functools.partial(_adam_seg_kernel, eps=eps,
+                          adam_w_mode=adam_w_mode, npad=npad, R=R),
+        grid=(grid,),
+        in_specs=[spec_b, spec_b, spec_b, spec_b,
+                  pl.BlockSpec((9, 1), lambda i: (0, 0)),
+                  bspec, bspec, bspec,
+                  pl.BlockSpec((1, 1), lambda i: (0, 0))],
+        out_specs=[spec_b, spec_b, spec_b],
+        out_shape=[jax.ShapeDtypeStruct(p2.shape, p2.dtype),
+                   jax.ShapeDtypeStruct(m2.shape, m2.dtype),
+                   jax.ShapeDtypeStruct(v2.shape, v2.dtype)],
+        input_output_aliases={0: 0, 1: 1, 2: 2},
+        interpret=pallas_interpret(),
+    )(p2, m2, v2, g2, scalars, lo, hi, vals, off)
+    return _from2d(pn, np_), _from2d(mn, np_), _from2d(vn, np_)
+
+
+def _adam_seg_reference(p, m, v, g, scalars, eps, adam_w_mode, wd_elem,
+                        lrs_elem):
+    """Per-element-vector oracle with the same folded-scalar contract."""
+    (lr_eff, inv_scale, b1e, c1, b2e, c2, rbc1, rbc2, found) = [
+        scalars[i, 0] for i in range(9)]
+    g = jnp.where(found > 0.5, 0.0, g.astype(jnp.float32) * inv_scale)
+    p32 = p.astype(jnp.float32)
+    if not adam_w_mode:
+        g = g + wd_elem * p32
+    m_new = b1e * m.astype(jnp.float32) + c1 * g
+    v_new = b2e * v.astype(jnp.float32) + c2 * (g * g)
+    update = (m_new * rbc1) / (jnp.sqrt(v_new * rbc2) + eps)
+    if adam_w_mode:
+        update = update + wd_elem * p32
+    p_new = p32 - lr_eff * lrs_elem * update
     return (p_new.astype(p.dtype), m_new.astype(m.dtype),
             v_new.astype(v.dtype))
 
@@ -370,12 +504,60 @@ def _lamb_phase1_kernel(m_ref, v_ref, g_ref, p_ref, sc_ref,
     u_out[...] = u.astype(u_out.dtype)
 
 
+def _lamb_phase1_seg_kernel(m_ref, v_ref, g_ref, p_ref, sc_ref, lo_ref,
+                            hi_ref, vals_ref, off_ref, m_out, v_out,
+                            u_out, *, eps, npad, R):
+    """Phase 1 with PER-TENSOR weight decay (vals row 0), rebuilt per
+    block from segment row bounds — the LAMB consumer of
+    get_params_for_weight_decay_optimization's no-decay mask (lr scale
+    rides in phase 2's per-tensor ratio, zero extra work)."""
+    i = pl.program_id(0)
+    oh = _block_onehot(lo_ref, hi_ref, off_ref, i, R, npad)
+    wd_row = jax.lax.dot_general(oh, vals_ref[0:1, :],
+                                 (((1,), (1,)), ((), ())),
+                                 precision=jax.lax.Precision.HIGHEST)
+    g = g_ref[...].astype(jnp.float32)
+    p = p_ref[...].astype(jnp.float32)
+    g = jnp.where(sc_ref[7, 0] > 0.5, 0.0, g * sc_ref[0, 0])
+    m_new = sc_ref[1, 0] * m_ref[...] + sc_ref[2, 0] * g
+    v_new = sc_ref[3, 0] * v_ref[...] + sc_ref[4, 0] * (g * g)
+    u = (m_new * sc_ref[5, 0]) / (jnp.sqrt(v_new * sc_ref[6, 0]) + eps)
+    u = u + wd_row * p
+    m_out[...] = m_new.astype(m_out.dtype)
+    v_out[...] = v_new.astype(v_out.dtype)
+    u_out[...] = u.astype(u_out.dtype)
+
+
 def _lamb_phase2_kernel(p_ref, u_ref, r_ref, sc_ref, p_out):
     """Phase 2 ≡ multi_tensor_lamb_stage2: p -= lr * trust_ratio * u, with
     the per-element trust-ratio vector r."""
     lr = sc_ref[0, 0]
     p = p_ref[...].astype(jnp.float32)
     p_out[...] = (p - lr * r_ref[...] * u_ref[...]).astype(p_out.dtype)
+
+
+def _lamb_fold_scalars(clip_ratio, step, beta1, beta2, bias_correction,
+                       grad_averaging, inv_scale, found_inf):
+    """The ONE definition of the LAMB phase-1 folded-scalar rows
+    (shared by the uniform and per-tensor-seg variants)."""
+    beta3 = (1.0 - beta1) if grad_averaging else 1.0
+    step = jnp.asarray(step, jnp.float32)
+    bc1 = jnp.maximum(1.0 - jnp.power(jnp.float32(beta1), step), 1e-20)
+    bc2 = jnp.maximum(1.0 - jnp.power(jnp.float32(beta2), step), 1e-20)
+    one = jnp.float32(1.0)
+    keep = jnp.asarray(found_inf).astype(jnp.bool_)
+    g_scale = (jnp.asarray(clip_ratio, jnp.float32)
+               * jnp.asarray(inv_scale, jnp.float32))
+    return jnp.stack([
+        g_scale,
+        jnp.where(keep, one, jnp.float32(beta1)),          # b1e
+        jnp.where(keep, 0.0, jnp.float32(beta3)),          # c1
+        jnp.where(keep, one, jnp.float32(beta2)),          # b2e
+        jnp.where(keep, 0.0, 1.0 - jnp.float32(beta2)),    # c2
+        one / bc1 if bias_correction else one,             # rbc1
+        one / bc2 if bias_correction else one,             # rbc2
+        keep.astype(jnp.float32),                          # found
+    ]).reshape(8, 1)
 
 
 def lamb_phase1_flat(m, v, g, p, clip_ratio, step, *, beta1, beta2, eps,
@@ -386,24 +568,9 @@ def lamb_phase1_flat(m, v, g, p, clip_ratio, step, *, beta1, beta2, eps,
     block.  inv_scale and the overflow skip are folded into the scalar
     rows (≡ the capturable CUDA-graph LAMB), so callers need no extra
     whole-buffer passes for unscale or skip-masking."""
-    beta3 = (1.0 - beta1) if grad_averaging else 1.0
-    step = jnp.asarray(step, jnp.float32)
-    bc1 = jnp.maximum(1.0 - jnp.power(jnp.float32(beta1), step), 1e-20)
-    bc2 = jnp.maximum(1.0 - jnp.power(jnp.float32(beta2), step), 1e-20)
-    one = jnp.float32(1.0)
-    keep = jnp.asarray(found_inf).astype(jnp.bool_)
-    g_scale = (jnp.asarray(clip_ratio, jnp.float32)
-               * jnp.asarray(inv_scale, jnp.float32))
-    scalars = jnp.stack([
-        g_scale,
-        jnp.where(keep, one, jnp.float32(beta1)),          # b1e
-        jnp.where(keep, 0.0, jnp.float32(beta3)),          # c1
-        jnp.where(keep, one, jnp.float32(beta2)),          # b2e
-        jnp.where(keep, 0.0, 1.0 - jnp.float32(beta2)),    # c2
-        one / bc1 if bias_correction else one,             # rbc1
-        one / bc2 if bias_correction else one,             # rbc2
-        keep.astype(jnp.float32),                          # found
-    ]).reshape(8, 1)
+    scalars = _lamb_fold_scalars(clip_ratio, step, beta1, beta2,
+                                 bias_correction, grad_averaging,
+                                 inv_scale, found_inf)
     if not use_pallas(use_pallas_override):
         g32 = jnp.where(scalars[7, 0] > 0.5, 0.0,
                         g.astype(jnp.float32) * scalars[0, 0])
@@ -439,6 +606,65 @@ def lamb_phase1_flat(m, v, g, p, clip_ratio, step, *, beta1, beta2, eps,
         input_output_aliases={0: 0, 1: 1},
         interpret=pallas_interpret(),
     )(m2, v2, g2, p2, scalars)
+    return _from2d(mn, n), _from2d(vn, n), _from2d(u, n)
+
+
+def lamb_phase1_seg(m, v, g, p, clip_ratio, step, *, wd_values, spec,
+                    row_offset=0, padded_total=None, beta1, beta2, eps,
+                    bias_correction=True, grad_averaging=True,
+                    inv_scale=1.0, found_inf=False,
+                    use_pallas_override=None):
+    """lamb_phase1_flat with a per-tensor weight-decay vector expanded
+    in-kernel from the (lane-aligned) spec's row bounds."""
+    scalars = _lamb_fold_scalars(clip_ratio, step, beta1, beta2,
+                                 bias_correction, grad_averaging,
+                                 inv_scale, found_inf)
+    wd_values = jnp.asarray(wd_values, jnp.float32)
+    n_seg = wd_values.shape[0]
+    npad = _seg_pad(n_seg)
+    if not (use_pallas(use_pallas_override) and n_seg + 1 < _SEG_CAP
+            and p.shape[0] % FLAT_TILE == 0):
+        rows = p.shape[0] // _LANES
+        total = padded_total if padded_total is not None else p.shape[0]
+        rank = jnp.asarray(row_offset, jnp.int32) // rows
+        seg = shard_segment_ids(spec, rank, rows, total)
+        wd_elem = expand_per_tensor_shard(wd_values, seg)
+        g32 = jnp.where(scalars[7, 0] > 0.5, 0.0,
+                        g.astype(jnp.float32) * scalars[0, 0])
+        p32 = p.astype(jnp.float32)
+        m_new = scalars[1, 0] * m + scalars[2, 0] * g32
+        v_new = scalars[3, 0] * v + scalars[4, 0] * (g32 * g32)
+        u = (m_new * scalars[5, 0]) / (
+            jnp.sqrt(v_new * scalars[6, 0]) + eps)
+        u = u + wd_elem * p32
+        return (m_new.astype(m.dtype), v_new.astype(v.dtype),
+                u.astype(p.dtype))
+    m2, n = _to2d(m)
+    v2, _ = _to2d(v)
+    g2, _ = _to2d(g)
+    p2, _ = _to2d(p)
+    R = _BLOCK_ROWS
+    grid = m2.shape[0] // R
+    lo, hi = _seg_row_bounds(spec, npad)
+    vals8 = jnp.zeros((8, npad), jnp.float32).at[0, :n_seg].set(wd_values)
+    off = jnp.asarray(row_offset, jnp.int32).reshape(1, 1)
+    bspec = pl.BlockSpec((8, npad), lambda i: (0, 0))
+    spec_b = pl.BlockSpec((R, _LANES), lambda i: (i, 0))
+    mn, vn, u = pl.pallas_call(
+        functools.partial(_lamb_phase1_seg_kernel, eps=eps, npad=npad,
+                          R=R),
+        grid=(grid,),
+        in_specs=[spec_b, spec_b, spec_b, spec_b,
+                  pl.BlockSpec((8, 1), lambda i: (0, 0)),
+                  bspec, bspec, bspec,
+                  pl.BlockSpec((1, 1), lambda i: (0, 0))],
+        out_specs=[spec_b, spec_b, spec_b],
+        out_shape=[jax.ShapeDtypeStruct(m2.shape, m2.dtype),
+                   jax.ShapeDtypeStruct(v2.shape, v2.dtype),
+                   jax.ShapeDtypeStruct(p2.shape, p2.dtype)],
+        input_output_aliases={0: 0, 1: 1},
+        interpret=pallas_interpret(),
+    )(m2, v2, g2, p2, scalars, lo, hi, vals8, off)
     return _from2d(mn, n), _from2d(vn, n), _from2d(u, n)
 
 

@@ -17,7 +17,11 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
-from apex_tpu.ops._common import pallas_interpret, row_block, use_pallas
+from apex_tpu.ops._common import (
+    pallas_interpret,
+    row_block,
+    use_pallas_fusable,
+)
 
 
 def _stats_kernel(x_ref, sum_ref, sq_ref):
@@ -40,7 +44,15 @@ def channel_sums(x2):
 
 
 def _channel_sums_impl(x2):
-    if not use_pallas(None):
+    # fusable-op rule (≡ LayerNorm, ops/_common.use_pallas_fusable):
+    # XLA fuses the (sum, sumsq) multi-output reduction into the
+    # producing conv's consumer; the standalone Pallas kernel costs a
+    # custom-call boundary + an extra HBM pass.  Measured on v5e at
+    # the RN50 bench point (b256): BN stack fwd+bwd 55.1 ms (Pallas)
+    # vs 21.6 ms (XLA), full model fwd(train) 66.4 -> 27.6 ms
+    # (scripts/resnet_profile.py) — the 4-round ResNet plateau was
+    # mostly THIS kernel.
+    if not use_pallas_fusable(None):
         x32 = x2.astype(jnp.float32)
         return jnp.sum(x32, axis=0), jnp.sum(x32 * x32, axis=0)
     rows, c = x2.shape

@@ -117,7 +117,8 @@ class DistributedFusedAdam(_ShardedFlat):
                  weight_decay=0.0, axis_name: str = DP_AXIS,
                  grad_sync_dtype=jnp.float32, param_sync_dtype=None,
                  n_buckets: int = 1, master_dtype=jnp.float32,
-                 use_pallas: Optional[bool] = None):
+                 use_pallas: Optional[bool] = None,
+                 wd_mask=None, lr_scales=None):
         """master_dtype=bf16 shards bf16 p/m/v state (in-kernel math
         stays fp32) — the ZeRO counterpart of FusedAdam's bf16-state
         dial; halves per-rank state memory AND the update-pass HBM
@@ -131,7 +132,13 @@ class DistributedFusedAdam(_ShardedFlat):
         distributed_fused_adam.py:652-712 + bucket sync 1274-1571 —
         one fused psum_scatter cannot start before the LAST grad
         exists).  The shard layout becomes bucket-major; init/step/
-        gather and the checkpoint fingerprint all agree on it."""
+        gather and the checkpoint fingerprint all agree on it.
+
+        wd_mask / lr_scales: optional per-leaf pytrees (same structure
+        as init's params) ≡ the reference's param_groups — see
+        FusedAdam; applied per bucket shard with the shard's global row
+        offset, so every rank updates its fragment with the right
+        per-tensor hyperparameters."""
         self.num_shards = num_shards
         self.lr = lr
         self.bias_correction = bias_correction
@@ -145,6 +152,13 @@ class DistributedFusedAdam(_ShardedFlat):
         self.n_buckets = n_buckets
         self.master_dtype = master_dtype
         self.use_pallas = use_pallas
+        self.wd_mask = wd_mask
+        self.lr_scales = lr_scales
+        self._seg_wd = None
+        self._seg_lrs = None
+        if wd_mask is not None or lr_scales is not None:
+            # per-leaf hyperparameters need lane-aligned leaf segments
+            self._ALIGN = K._LANES
         self.spec: Optional[F.FlatSpec] = None
         self.padded_total = None
 
@@ -166,6 +180,10 @@ class DistributedFusedAdam(_ShardedFlat):
         flats = self._bucket_flats(params, self.master_dtype)
         self._bucket_padded = [f.shape[0] for f in flats]
         self.padded_total = sum(self._bucket_padded)
+        if self.wd_mask is not None or self.lr_scales is not None:
+            self._seg_wd, self._seg_lrs = F.resolve_per_leaf(
+                self.wd_mask, self.lr_scales, self.weight_decay, params,
+                type(self).__name__)
         rank = lax.axis_index(self.axis_name)
         shard = jnp.concatenate([
             lax.dynamic_slice(f, (rank * (n // self.num_shards),),
@@ -224,14 +242,43 @@ class DistributedFusedAdam(_ShardedFlat):
             for gb in self._bucket_flats(grads, self.grad_sync_dtype)])
         found = jnp.asarray(found_inf)
         step_next = state.step + jnp.where(found, 0, 1).astype(jnp.int32)
-        p, m, v = K.adam_flat(
-            state.params_shard, state.exp_avg, state.exp_avg_sq, g_shard,
+        common = dict(
             lr=self.lr if lr is None else lr,
             step=step_next.astype(jnp.float32),
             beta1=self.beta1, beta2=self.beta2, eps=self.eps,
-            weight_decay=self.weight_decay, adam_w_mode=self.adam_w_mode,
+            adam_w_mode=self.adam_w_mode,
             bias_correction=self.bias_correction, inv_scale=inv_scale,
             found_inf=found, use_pallas_override=self.use_pallas)
+        if self._seg_wd is not None:
+            # per-leaf hyperparameters: one seg-kernel call per bucket
+            # shard (each is FLAT_TILE-aligned), with the shard's global
+            # row offset inside ITS bucket and the bucket's leaf range
+            # of the per-tensor vectors
+            rank = lax.axis_index(ax)
+            ps, ms, vs = [], [], []
+            off = 0
+            for (a, b), spec_i, padded_i in zip(
+                    self._ranges, self.bucket_specs, self._bucket_padded):
+                sz = padded_i // self.num_shards
+                sl = lambda arr: lax.dynamic_slice(arr, (off,), (sz,))
+                pi, mi, vi = K.adam_flat_seg(
+                    sl(state.params_shard), sl(state.exp_avg),
+                    sl(state.exp_avg_sq), sl(g_shard),
+                    wd_values=self._seg_wd[a:b],
+                    lr_scale_values=self._seg_lrs[a:b],
+                    spec=spec_i, row_offset=rank * (sz // K._LANES),
+                    padded_total=padded_i, **common)
+                ps.append(pi)
+                ms.append(mi)
+                vs.append(vi)
+                off += sz
+            p = jnp.concatenate(ps)
+            m = jnp.concatenate(ms)
+            v = jnp.concatenate(vs)
+        else:
+            p, m, v = K.adam_flat(
+                state.params_shard, state.exp_avg, state.exp_avg_sq,
+                g_shard, weight_decay=self.weight_decay, **common)
         new_state = DistributedFusedAdamState(
             step=step_next, params_shard=p, exp_avg=m, exp_avg_sq=v)
         # param all-gather ≡ the bucketed all-gather param sync
@@ -259,7 +306,8 @@ class DistributedFusedLAMB(_ShardedFlat):
                  max_grad_norm=1.0, axis_name: str = DP_AXIS,
                  grad_sync_dtype=jnp.float32, param_sync_dtype=None,
                  master_dtype=jnp.float32,
-                 use_pallas: Optional[bool] = None):
+                 use_pallas: Optional[bool] = None,
+                 wd_mask=None, lr_scales=None):
         self.num_shards = num_shards
         self.lr = lr
         self.bias_correction = bias_correction
@@ -272,6 +320,10 @@ class DistributedFusedLAMB(_ShardedFlat):
         self.param_sync_dtype = param_sync_dtype
         self.master_dtype = master_dtype
         self.use_pallas = use_pallas
+        self.wd_mask = wd_mask
+        self.lr_scales = lr_scales
+        self._seg_wd = None
+        self._seg_lrs = None
         self.spec = None
         self.padded_total = None
 
@@ -279,6 +331,10 @@ class DistributedFusedLAMB(_ShardedFlat):
         self._make_spec(params)
         flat = self._flatten(params, self.master_dtype)
         self.padded_total = flat.shape[0]
+        if self.wd_mask is not None or self.lr_scales is not None:
+            self._seg_wd, self._seg_lrs = F.resolve_per_leaf(
+                self.wd_mask, self.lr_scales, self.weight_decay, params,
+                type(self).__name__)
         shard_size = self.padded_total // self.num_shards
         rank = lax.axis_index(self.axis_name)
         shard = lax.dynamic_slice(flat, (rank * shard_size,), (shard_size,))
@@ -309,14 +365,29 @@ class DistributedFusedLAMB(_ShardedFlat):
             self.max_grad_norm / gnorm, 1.0)
 
         # overflow skip folded into the kernels (≡ FusedLAMB.step)
-        m, v, u = K.lamb_phase1_flat(
-            state.exp_avg, state.exp_avg_sq, g_shard, state.params_shard,
-            clip_ratio=clip, step=step_next.astype(jnp.float32),
-            beta1=self.beta1, beta2=self.beta2, eps=self.eps,
-            weight_decay=self.weight_decay,
-            bias_correction=self.bias_correction,
-            inv_scale=inv_scale, found_inf=found,
-            use_pallas_override=self.use_pallas)
+        shard_rows = state.params_shard.shape[0] // K._LANES
+        if self._seg_wd is not None:
+            m, v, u = K.lamb_phase1_seg(
+                state.exp_avg, state.exp_avg_sq, g_shard,
+                state.params_shard,
+                clip_ratio=clip, step=step_next.astype(jnp.float32),
+                wd_values=self._seg_wd, spec=self.spec,
+                row_offset=lax.axis_index(ax) * shard_rows,
+                padded_total=self.padded_total,
+                beta1=self.beta1, beta2=self.beta2, eps=self.eps,
+                bias_correction=self.bias_correction,
+                inv_scale=inv_scale, found_inf=found,
+                use_pallas_override=self.use_pallas)
+        else:
+            m, v, u = K.lamb_phase1_flat(
+                state.exp_avg, state.exp_avg_sq, g_shard,
+                state.params_shard,
+                clip_ratio=clip, step=step_next.astype(jnp.float32),
+                beta1=self.beta1, beta2=self.beta2, eps=self.eps,
+                weight_decay=self.weight_decay,
+                bias_correction=self.bias_correction,
+                inv_scale=inv_scale, found_inf=found,
+                use_pallas_override=self.use_pallas)
 
         # per-tensor norms WITHOUT materializing the full buffers: each
         # rank computes partial per-tensor sums of squares over its own
@@ -340,6 +411,8 @@ class DistributedFusedLAMB(_ShardedFlat):
         un = jnp.sqrt(sums[n_t:])
         ratio = jnp.where((wn > 0) & (un > 0), wn / jnp.maximum(un, 1e-12),
                           1.0)
+        if self._seg_lrs is not None:
+            ratio = ratio * jnp.asarray(self._seg_lrs)
 
         lr_eff = jnp.where(found, 0.0, jnp.asarray(lr_val, jnp.float32))
         p = K.lamb_phase2_seg(state.params_shard, u, ratio, self.spec,

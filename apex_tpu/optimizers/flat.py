@@ -105,6 +105,42 @@ def unflatten(flat, spec: FlatSpec, cast_to_leaf_dtype: bool = True):
     return jax.tree_util.tree_unflatten(spec.treedef, leaves)
 
 
+def per_leaf_scalars(tree, params, who: str) -> np.ndarray:
+    """Flatten a per-leaf scalar pytree (bools or floats — e.g. the
+    wd_mask from get_params_for_weight_decay_optimization, or per-leaf
+    lr multipliers) into an (n_leaves,) fp32 vector in param leaf order.
+    The tree's STRUCTURE must match params' exactly (a same-count tree
+    with different keys would silently assign hyperparameters to the
+    wrong tensors).  ≡ the reference's param_groups: each leaf's scalar
+    plays the role of its group's hyperparameter
+    (apex/optimizers/fused_adam.py:156-303)."""
+    want = jax.tree_util.tree_structure(params)
+    got = jax.tree_util.tree_structure(tree)
+    if got != want:
+        raise ValueError(
+            f"{who}: per-leaf tree structure/leaves do not match the "
+            f"params pytree ({got} vs {want}) — build it with tree_map "
+            "over the same params pytree")
+    return np.asarray([float(x) for x in jax.tree_util.tree_leaves(tree)],
+                      np.float32)
+
+
+def resolve_per_leaf(wd_mask, lr_scales, weight_decay: float, params,
+                     who: str):
+    """The ONE definition of per-leaf hyperparameter resolution shared
+    by FusedAdam/FusedLAMB and their ZeRO variants: returns
+    (seg_wd, seg_lrs) fp32 vectors in leaf order — wd_mask leaves
+    multiply `weight_decay` (bool → 0/1), lr_scales leaves multiply the
+    learning rate; an absent tree falls back to the uniform value."""
+    n = len(jax.tree_util.tree_leaves(params))
+    seg_wd = (weight_decay * per_leaf_scalars(wd_mask, params, who)
+              if wd_mask is not None
+              else np.full((n,), weight_decay, np.float32))
+    seg_lrs = (per_leaf_scalars(lr_scales, params, who)
+               if lr_scales is not None else np.ones((n,), np.float32))
+    return seg_wd, seg_lrs
+
+
 def layout_dict(spec: FlatSpec) -> dict:
     """Layout fingerprint stored inside optimizer state_dicts so a
     checkpoint written under one flat layout cannot be silently restored

@@ -36,11 +36,19 @@ class FusedLAMB(F.FlatCheckpointMixin):
                  adam_w_mode=True, grad_averaging=True,
                  max_grad_norm=1.0, use_nvlamb=False,
                  master_dtype=jnp.float32,
-                 use_pallas: Optional[bool] = None):
+                 use_pallas: Optional[bool] = None,
+                 wd_mask=None, lr_scales=None):
         """master_dtype=bf16 keeps p/m/v/u in bf16 — halves the LAMB
         pass's HBM traffic (the dominant cost at BERT-Large scale; all
         in-kernel math stays fp32) at ~8-bit state precision, the same
-        dial as FusedAdam's 1.3B bf16-state point (docs/PERF.md)."""
+        dial as FusedAdam's 1.3B bf16-state point (docs/PERF.md).
+
+        wd_mask / lr_scales: optional per-leaf pytrees (same structure
+        as init's params) ≡ the reference's param_groups — wd_mask
+        leaves multiply `weight_decay` per tensor (pass
+        get_params_for_weight_decay_optimization(params) for the BERT
+        no-decay-bias/LN recipe); lr_scales folds into the per-tensor
+        trust ratio, costing nothing extra."""
         if amsgrad:
             raise RuntimeError("FusedLAMB does not support the AMSGrad variant.")
         self.lr = lr
@@ -54,12 +62,20 @@ class FusedLAMB(F.FlatCheckpointMixin):
         self.use_nvlamb = use_nvlamb
         self.master_dtype = master_dtype
         self.use_pallas = use_pallas
+        self.wd_mask = wd_mask
+        self.lr_scales = lr_scales
+        self._seg_wd = None
+        self._seg_lrs = None
         self.spec = None
 
     def init(self, params) -> FusedLAMBState:
         self.spec = F.make_spec(params, align=K._LANES)
         flat = F.flatten(params, self.master_dtype, pad_to=K.FLAT_TILE,
                          align=K._LANES)
+        if self.wd_mask is not None or self.lr_scales is not None:
+            self._seg_wd, self._seg_lrs = F.resolve_per_leaf(
+                self.wd_mask, self.lr_scales, self.weight_decay, params,
+                type(self).__name__)
         zeros = jnp.zeros_like(flat)
         return FusedLAMBState(step=jnp.zeros((), jnp.int32), params=flat,
                               exp_avg=zeros, exp_avg_sq=zeros)
@@ -88,15 +104,26 @@ class FusedLAMB(F.FlatCheckpointMixin):
             clip = jnp.float32(1.0)
         # overflow skip rides inside the kernels (lr_eff=0 / moment
         # coefficients folded) — no whole-buffer where-masks
-        m, v, u = K.lamb_phase1_flat(
-            state.exp_avg, state.exp_avg_sq, g_flat, state.params,
-            clip_ratio=clip, step=step_next.astype(jnp.float32),
-            beta1=self.beta1, beta2=self.beta2, eps=self.eps,
-            weight_decay=self.weight_decay,
-            bias_correction=self.bias_correction,
-            grad_averaging=self.grad_averaging,
-            inv_scale=inv_scale, found_inf=found,
-            use_pallas_override=self.use_pallas)
+        if self._seg_wd is not None:
+            m, v, u = K.lamb_phase1_seg(
+                state.exp_avg, state.exp_avg_sq, g_flat, state.params,
+                clip_ratio=clip, step=step_next.astype(jnp.float32),
+                wd_values=self._seg_wd, spec=self.spec,
+                beta1=self.beta1, beta2=self.beta2, eps=self.eps,
+                bias_correction=self.bias_correction,
+                grad_averaging=self.grad_averaging,
+                inv_scale=inv_scale, found_inf=found,
+                use_pallas_override=self.use_pallas)
+        else:
+            m, v, u = K.lamb_phase1_flat(
+                state.exp_avg, state.exp_avg_sq, g_flat, state.params,
+                clip_ratio=clip, step=step_next.astype(jnp.float32),
+                beta1=self.beta1, beta2=self.beta2, eps=self.eps,
+                weight_decay=self.weight_decay,
+                bias_correction=self.bias_correction,
+                grad_averaging=self.grad_averaging,
+                inv_scale=inv_scale, found_inf=found,
+                use_pallas_override=self.use_pallas)
 
         # per-tensor trust ratios ≡ the lamb kernel's
         # ratio = w_norm / u_norm when both > 0 else 1 — one-hot MXU
@@ -107,6 +134,9 @@ class FusedLAMB(F.FlatCheckpointMixin):
             u, self.spec, use_pallas_override=self.use_pallas)
         ratio = jnp.where((wn > 0) & (un > 0), wn / jnp.maximum(un, 1e-12),
                           1.0)
+        if self._seg_lrs is not None:
+            # per-leaf lr rides the per-tensor ratio — zero extra passes
+            ratio = ratio * jnp.asarray(self._seg_lrs)
         lr_eff = jnp.where(found, 0.0, jnp.asarray(lr_val, jnp.float32))
         p = K.lamb_phase2_seg(state.params, u, ratio, self.spec, lr_eff,
                               use_pallas_override=self.use_pallas)

@@ -259,8 +259,15 @@ def _bert_seq_per_sec(on_tpu):
                       dtype=jnp.bfloat16))
     model = Bert(cfg)
     params = model.init(jax.random.PRNGKey(0))
+    # standard BERT recipe: no weight decay for bias/LayerNorm params
+    # (≡ _get_params_for_weight_decay_optimization's two param groups)
+    from apex_tpu.transformer.pipeline_parallel.common import (
+        get_params_for_weight_decay_optimization,
+    )
+    wd_mask = get_params_for_weight_decay_optimization(params)
     opt = FusedLAMB(lr=1e-4, weight_decay=0.01, use_pallas=on_tpu,
-                    master_dtype=jnp.bfloat16 if on_tpu else jnp.float32)
+                    master_dtype=jnp.bfloat16 if on_tpu else jnp.float32,
+                    wd_mask=wd_mask)
     opt_state = init_sharded_optimizer(opt, model, params, mesh)
     del params
     tokens = jax.random.randint(jax.random.PRNGKey(1), (batch, seq), 0,
@@ -300,7 +307,13 @@ def _resnet50_img_per_sec(on_tpu):
         (4, 32, "resnet18")
     M.destroy_model_parallel()
     mesh = M.initialize_model_parallel(devices=jax.devices()[:1])
-    model = ResNet(arch, num_classes=1000, axis_name="dp")
+    # space_to_depth stem computes the IDENTICAL function (exact weight
+    # rewrite, models/resnet.py) ~5 ms/step faster on v5e; round 5 also
+    # moved BN batch stats off the Pallas welford kernel onto XLA's
+    # fused reductions (ops/welford.py) — together 1,665 -> 2,305-2,319
+    # img/s (3 runs; docs/PERF.md has the per-layer anatomy)
+    model = ResNet(arch, num_classes=1000, axis_name="dp",
+                   stem="space_to_depth" if on_tpu else "conv7")
     params, mstate = model.init(jax.random.PRNGKey(0))
     amp_state = amp.initialize(opt_level="O1")
 

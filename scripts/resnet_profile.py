@@ -16,8 +16,11 @@ Usage:  python scripts/resnet_profile.py [conv|bn|pool|step|all]
 
 from __future__ import annotations
 
+import os
 import sys
 import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
 import jax.numpy as jnp
@@ -28,37 +31,56 @@ PEAK_TFLOPS = 197.0  # v5e bf16
 HBM_GBPS = 819.0     # v5e
 
 B = 256
-K_INNER = 10  # iterations inside one jit call
+K_INNER = 100  # iterations inside one jit call (per-call overhead ~20ms)
 
 
-def _scan_time(op, out_to_scalar, *args, iters=K_INNER, reps=3):
+def _scan_time(op, out_to_scalar, *args, iters=K_INNER, reps=5):
     """Time `op(*args)` by running `iters` copies inside one jitted scan,
     chaining a tiny scalar from each output into the next input so XLA
-    cannot hoist or CSE the body.  Returns seconds per op."""
+    cannot hoist or CSE the body.  Returns seconds per op.
 
-    def many(*a):
-        def body(carry, _):
-            perturbed = (a[0] + carry.astype(a[0].dtype),) + a[1:]
-            out = op(*perturbed)
-            return out_to_scalar(out) * 1e-30, None
+    Per-call dispatch through the remote tunnel is ~80-90 ms, so `reps`
+    calls are issued back-to-back and synced ONCE — dispatch overlaps
+    device execution exactly as in bench.py's timing loops."""
 
-        c, _ = lax.scan(body, jnp.zeros((), jnp.float32), None,
-                        length=iters)
-        return c
+    def make(length):
+        def many(*a):
+            def body(carry, _):
+                perturbed = (a[0] + carry.astype(a[0].dtype),) + a[1:]
+                out = op(*perturbed)
+                return out_to_scalar(out) * 1e-30, None
 
-    f = jax.jit(many)
-    _ = np.asarray(f(*args))  # compile + warm
-    best = np.inf
-    for _ in range(reps):
-        t0 = time.perf_counter()
-        _ = np.asarray(f(*args))
-        best = min(best, time.perf_counter() - t0)
-    return best / iters
+            c, _ = lax.scan(body, jnp.zeros((), jnp.float32), None,
+                            length=length)
+            return c
+        return jax.jit(many)
+
+    def total(f):
+        _ = np.asarray(f(*args))  # compile
+        _ = np.asarray(f(*args))  # warm
+        best = np.inf
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            _ = np.asarray(f(*args))
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    # two-point slope cancels the flat per-call overhead exactly
+    lo, hi = max(1, iters // 5), iters
+    if hi == lo:
+        return total(make(hi)) / hi  # overhead-inclusive single point
+    t_lo = total(make(lo))
+    t_hi = total(make(hi))
+    return max((t_hi - t_lo) / (hi - lo), 1e-9)
 
 
 def _first_scalar(out):
-    leaf = jax.tree.leaves(out)[0]
-    return leaf.ravel()[0].astype(jnp.float32)
+    # sum over EVERY leaf: a single element would let XLA slice-sink
+    # through the op and compute one output pixel (measured: "conv"
+    # above peak FLOPs); a full ravel()[0] would force a 1-D relayout.
+    # The fused sum costs one read of each output — the roofline floor.
+    return sum(jnp.sum(leaf.astype(jnp.float32))
+               for leaf in jax.tree.leaves(out))
 
 
 # (name, H, W, Cin, Cout, k, stride, multiplicity) — every unique conv
@@ -107,13 +129,20 @@ def conv_roofline():
                 x, wgt, (s, s), "SAME",
                 dimension_numbers=("NHWC", "HWIO", "NHWC"))
 
-        def fb(x, wgt):
-            return jax.grad(
-                lambda x, w: conv(x, w).astype(jnp.float32).sum(),
-                argnums=(0, 1))(x, wgt)
+        ho_, wo_ = -(-h // s), -(-w // s)
+        dy = jax.random.normal(jax.random.PRNGKey(2), (B, ho_, wo_, cout),
+                               jnp.bfloat16)
 
-        t_fb = _scan_time(fb, _first_scalar, x, wgt)
-        ho, wo = -(-h // s), -(-w // s)
+        def fb(x, wgt, dy):
+            # random cotangent through jax.vjp: grad of .sum() has a
+            # constant dy that XLA folds into near-free backward convs
+            # (measured 2x "above peak")
+            out, vjp = jax.vjp(conv, x, wgt)
+            dx, dw = vjp(dy)
+            return out, dx, dw
+
+        t_fb = _scan_time(fb, _first_scalar, x, wgt, dy)
+        ho, wo = ho_, wo_
         flops = 2 * B * ho * wo * cin * cout * k * k
         # fwd+bwd traffic ~ 3 passes x (in + out) at bf16
         traffic = 3 * 2 * B * (h * w * cin + ho * wo * cout)
@@ -145,19 +174,22 @@ def bn_cost():
         for h, w, c, mult in shapes:
             x = jax.random.normal(jax.random.PRNGKey(0), (B, h, w, c),
                                   jnp.bfloat16)
+            dy = jax.random.normal(jax.random.PRNGKey(1), (B, h, w, c),
+                                   jnp.bfloat16)
             scale = jnp.ones((c,))
             bias = jnp.zeros((c,))
             rm = jnp.zeros((c,))
             rv = jnp.ones((c,))
 
-            def fb(x, scale, bias, rm, rv):
+            def fb(x, scale, bias, dy):
                 def f(x, scale, bias):
                     y, _, _ = sync_batch_norm(x, scale, bias, rm, rv,
                                               training=True)
-                    return y.astype(jnp.float32).sum()
-                return jax.grad(f, argnums=(0, 1, 2))(x, scale, bias)
+                    return y
+                y, vjp = jax.vjp(f, x, scale, bias)
+                return (y,) + vjp(dy)
 
-            t = _scan_time(fb, _first_scalar, x, scale, bias, rm, rv)
+            t = _scan_time(fb, _first_scalar, x, scale, bias, dy)
             tot += mult * t
             gb = (B * h * w * c * 2) / 1e9
             print(f"  pallas={force} bn {h}x{w}x{c:<5} x{mult:>2} "
@@ -223,9 +255,31 @@ def step_decomp():
     C._FORCE = ""
 
 
+def calibrate():
+    """Per-call overhead vs per-iteration cost: time one mid-size conv
+    at different inner iteration counts; the slope is the true per-op
+    cost."""
+    x = jax.random.normal(jax.random.PRNGKey(0), (B, 28, 28, 128),
+                          jnp.bfloat16)
+    wgt = jax.random.normal(jax.random.PRNGKey(1), (3, 3, 128, 128),
+                            jnp.bfloat16) * 0.05
+
+    def conv(x, wgt):
+        return lax.conv_general_dilated(
+            x, wgt, (1, 1), "SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+    for it in (1, 10, 40, 100, 200):
+        t = _scan_time(conv, _first_scalar, x, wgt, iters=it)
+        print(f"iters={it:>3}: {t*1e3:.3f} ms/op (total {t*it*1e3:.1f})")
+
+
 def main():
     which = sys.argv[1] if len(sys.argv) > 1 else "all"
     print(f"backend: {jax.default_backend()}  devices: {jax.devices()}")
+    if which == "calib":
+        calibrate()
+        return
     if which in ("conv", "all"):
         conv_roofline()
     if which in ("bn", "all"):

@@ -1,0 +1,126 @@
+"""True maxpool fwd+bwd cost (random cotangent) and a candidate
+equality-routed custom-vjp alternative to SelectAndScatter."""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+B = 256
+x = jax.random.normal(jax.random.PRNGKey(0), (B, 112, 112, 64),
+                      jnp.bfloat16)
+dy = jax.random.normal(jax.random.PRNGKey(1), (B, 56, 56, 64),
+                       jnp.bfloat16)
+
+
+def timeit(f, *args, iters=8, warmup=2):
+    for _ in range(warmup):
+        out = f(*args)
+    _ = np.asarray(jax.tree.leaves(out)[0].ravel()[:1])
+    t0 = time.perf_counter()
+    outs = [f(*args) for _ in range(iters)]
+    _ = np.asarray(jax.tree.leaves(outs[-1])[0].ravel()[:1])
+    return (time.perf_counter() - t0) / iters
+
+
+def mp(x):
+    return lax.reduce_window(x, -jnp.inf, lax.max, (1, 3, 3, 1),
+                             (1, 2, 2, 1), "SAME")
+
+
+def fb_ref(x, dy):
+    y, vjp = jax.vjp(mp, x)
+    return y, vjp(dy)[0]
+
+
+t = timeit(jax.jit(fb_ref), x, dy)
+print(f"reduce_window+SelectAndScatter fwd+bwd: {t*1e3:.3f} ms",
+      flush=True)
+
+
+# candidate: equality-routed backward — dx[p] = sum over the <=4
+# windows containing p of dy[w] * (x[p] == y[w]) / ties(w).
+# Gradient differs from select-and-scatter ONLY on exact fp ties
+# (routes split instead of first-wins).
+def mp_eq(x):
+    return mp(x)
+
+
+def mp_eq_fwd(x):
+    y = mp(x)
+    return y, (x, y)
+
+
+def _win_sum(a):
+    """sum over 3x3/s2 windows transposed back to input positions."""
+    # dilate dy to input grid: conv_transpose-like via reduce_window's
+    # transpose = pad + gather; use lax.pad + conv with ones? simplest:
+    # scatter-free: upsample dy to the padded input grid then 3x3 sum
+    raise NotImplementedError
+
+
+def mp_eq_bwd(res, dy):
+    x, y = res
+    # route dy[w] to every input position equal to the window max,
+    # normalized by tie count.  Windows overlap (k3 s2), so express as:
+    # for each of the 9 (di, dj) offsets, the window at output (i, j)
+    # touches input (2i+di-1, 2j+dj-1); accumulate via dynamic slicing
+    # on the padded grid — all dense vector ops, no SelectAndScatter.
+    xf = x.astype(jnp.float32)
+    yf = y.astype(jnp.float32)
+    dyf = dy.astype(jnp.float32)
+    bb, hh, ww, cc = x.shape
+    pad = [(0, 0), (1, 2), (1, 2), (0, 0)]
+    xp = jnp.pad(xf, pad, constant_values=-jnp.inf)
+    # tie count per window
+    ties = jnp.zeros_like(yf)
+    for di in range(3):
+        for dj in range(3):
+            xs = lax.slice(xp, (0, di, dj, 0),
+                           (bb, di + 2 * y.shape[1], dj + 2 * y.shape[2],
+                            cc), (1, 2, 2, 1))
+            ties = ties + (xs == yf).astype(jnp.float32)
+    contrib = dyf / ties
+    dxp = jnp.zeros(xp.shape, jnp.float32)
+    for di in range(3):
+        for dj in range(3):
+            xs = lax.slice(xp, (0, di, dj, 0),
+                           (bb, di + 2 * y.shape[1], dj + 2 * y.shape[2],
+                            cc), (1, 2, 2, 1))
+            upd = jnp.where(xs == yf, contrib, 0.0)
+            # scatter-add back at stride 2 — as a dynamic_update via
+            # strided "dilation": build with lax.pad(interior=1)
+            upd_dil = lax.pad(upd, jnp.float32(0),
+                              [(0, 0, 0), (di, xp.shape[1] - di - 1 -
+                                           2 * (y.shape[1] - 1), 1),
+                               (dj, xp.shape[2] - dj - 1 -
+                                2 * (y.shape[2] - 1), 1), (0, 0, 0)])
+            dxp = dxp + upd_dil
+    dx = lax.slice(dxp, (0, 1, 1, 0), (bb, 1 + hh, 1 + ww, cc))
+    return (dx.astype(x.dtype),)
+
+
+mp_eq = jax.custom_vjp(mp_eq)
+mp_eq.defvjp(mp_eq_fwd, mp_eq_bwd)
+
+
+def fb_eq(x, dy):
+    y, vjp = jax.vjp(mp_eq, x)
+    return y, vjp(dy)[0]
+
+
+t = timeit(jax.jit(fb_eq), x, dy)
+print(f"equality-routed custom vjp fwd+bwd:     {t*1e3:.3f} ms",
+      flush=True)
+
+# sanity: grads agree where no ties (random floats -> ties improbable)
+a, ga = jax.jit(fb_ref)(x, dy)
+b, gb = jax.jit(fb_eq)(x, dy)
+print("fwd equal:", bool(jnp.all(a == b)),
+      " bwd max diff:", float(jnp.max(jnp.abs(
+          ga.astype(jnp.float32) - gb.astype(jnp.float32)))))

@@ -1,0 +1,87 @@
+"""A/B the RN50 train-step tail: grads-only vs tree-SGD vs flat FusedSGD."""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from apex_tpu.models.resnet import ResNet
+from apex_tpu.ops.xentropy import softmax_cross_entropy_loss
+from apex_tpu.optimizers.fused_sgd import FusedSGD
+
+B = 256
+model = ResNet("resnet50", num_classes=1000, axis_name=None)
+params, mstate = model.init(jax.random.PRNGKey(0))
+params16 = jax.tree.map(lambda p: p.astype(jnp.bfloat16), params)
+x16 = jax.random.normal(jax.random.PRNGKey(1), (B, 224, 224, 3),
+                        jnp.bfloat16)
+y = jax.random.randint(jax.random.PRNGKey(2), (B,), 0, 1000)
+
+
+def lf(p, ms):
+    logits, nms = model.apply(p, ms, x16, training=True)
+    return jnp.mean(softmax_cross_entropy_loss(
+        logits.astype(jnp.float32), y)), nms
+
+
+def timeit(jstep, args, iters=8, warmup=2):
+    for _ in range(warmup):
+        args = jstep(*args)
+    _ = np.asarray(jax.tree.leaves(args)[0].ravel()[:1])
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        args = jstep(*args)
+    _ = np.asarray(jax.tree.leaves(args)[0].ravel()[:1])
+    return (time.perf_counter() - t0) / iters
+
+
+# A: grads only (no optimizer) — isolates the optimizer+unflatten cost
+def step_a(p, ms):
+    grads, nms = jax.grad(lf, has_aux=True)(p, ms)
+    return grads, nms
+
+
+t = timeit(jax.jit(step_a, donate_argnums=(0,)), (params16, mstate))
+print(f"A grads-only:      {t*1e3:7.2f} ms ({B/t:.0f} img/s)", flush=True)
+
+# B: tree SGD (per-leaf momentum fp32, params bf16, all donated)
+mom = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), params16)
+
+
+def step_b(p, mom, ms):
+    grads, nms = jax.grad(lf, has_aux=True)(p, ms)
+
+    def upd(p, g, m):
+        m = 0.9 * m + g.astype(jnp.float32) + 1e-4 * p.astype(jnp.float32)
+        return (p.astype(jnp.float32) - 0.1 * m).astype(p.dtype), m
+
+    out = jax.tree.map(upd, p, grads, mom)
+    newp = jax.tree.map(lambda o: o[0], out,
+                        is_leaf=lambda t: isinstance(t, tuple))
+    newm = jax.tree.map(lambda o: o[1], out,
+                        is_leaf=lambda t: isinstance(t, tuple))
+    return newp, newm, nms
+
+
+t = timeit(jax.jit(step_b, donate_argnums=(0, 1)), (params16, mom, mstate))
+print(f"B tree-SGD:        {t*1e3:7.2f} ms ({B/t:.0f} img/s)", flush=True)
+
+# C: flat FusedSGD (the current bench path)
+opt = FusedSGD(lr=0.1, momentum=0.9, weight_decay=1e-4)
+state = opt.init(params16)
+from apex_tpu.optimizers import flat as F
+
+
+def step_c(state, ms):
+    p = F.unflatten(state.params, opt.spec)
+    grads, nms = jax.grad(lf, has_aux=True)(p, ms)
+    _, new_state = opt.step(state, grads)
+    return new_state, nms
+
+
+t = timeit(jax.jit(step_c, donate_argnums=(0,)), (state, mstate))
+print(f"C flat FusedSGD:   {t*1e3:7.2f} ms ({B/t:.0f} img/s)", flush=True)

@@ -1,0 +1,65 @@
+"""Batch sweep + optimizer-dtype dial for the RN50 bench point (after
+the welford→XLA BN-stats switch moved the bottleneck)."""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from apex_tpu.models.resnet import ResNet
+from apex_tpu.ops.xentropy import softmax_cross_entropy_loss
+from apex_tpu.optimizers.fused_sgd import FusedSGD
+from apex_tpu.parallel import mesh as M
+
+
+def run_point(B, iters=8, warmup=2, stem="conv7"):
+    model = ResNet("resnet50", num_classes=1000, axis_name=None,
+                   stem=stem)
+    params, mstate = model.init(jax.random.PRNGKey(0))
+    params16 = jax.tree.map(lambda p: p.astype(jnp.bfloat16), params)
+    opt = FusedSGD(lr=0.1, momentum=0.9, weight_decay=1e-4)
+    state = opt.init(params16)
+    x16 = jax.random.normal(jax.random.PRNGKey(1), (B, 224, 224, 3),
+                            jnp.bfloat16)
+    y = jax.random.randint(jax.random.PRNGKey(2), (B,), 0, 1000)
+
+    def step(state, mstate):
+        from apex_tpu.optimizers import flat as F
+        p = F.unflatten(state.params, opt.spec)
+
+        def lf(p):
+            logits, nms = model.apply(p, mstate, x16, training=True)
+            loss = jnp.mean(softmax_cross_entropy_loss(
+                logits.astype(jnp.float32), y))
+            return loss, nms
+
+        grads, nms = jax.grad(lf, has_aux=True)(p)
+        _, new_state = opt.step(state, grads)
+        return new_state, nms
+
+    jstep = jax.jit(step, donate_argnums=(0, 1))
+    args = (state, mstate)
+    for _ in range(warmup):
+        args = jstep(*args)
+    _ = np.asarray(args[0].params.ravel()[:1])
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        args = jstep(*args)
+    _ = np.asarray(args[0].params.ravel()[:1])
+    dt = (time.perf_counter() - t0) / iters
+    print(f"B={B:<4} stem={stem:<15} {dt*1e3:8.2f} ms/step  "
+          f"{B/dt:8.0f} img/s", flush=True)
+
+
+if __name__ == "__main__":
+    which = sys.argv[1] if len(sys.argv) > 1 else "batch"
+    if which == "batch":
+        for B in (128, 256, 384, 512):
+            run_point(B)
+    elif which == "stem":
+        run_point(256, stem="conv7")
+        run_point(256, stem="space_to_depth")

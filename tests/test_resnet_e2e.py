@@ -67,3 +67,87 @@ def test_opt_level_parity():
     l0 = _train("O0", steps=3)
     l1 = _train("O1", steps=3)
     np.testing.assert_allclose(l0, l1, rtol=5e-2, atol=5e-2)
+
+
+def test_space_to_depth_stem_exact():
+    """stem="space_to_depth" computes the SAME function as the 7x7/s2
+    stem (identical params), to fp32 numerics."""
+    import numpy as np
+
+    from apex_tpu.models.resnet import ResNet
+
+    m1 = ResNet("resnet10", num_classes=10)
+    m2 = ResNet("resnet10", num_classes=10, stem="space_to_depth")
+    params, state = m1.init(jax.random.PRNGKey(0))
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 64, 64, 3))
+    y1, _ = m1.apply(params, state, x, training=False)
+    y2, _ = m2.apply(params, state, x, training=False)
+    np.testing.assert_allclose(np.asarray(y1), np.asarray(y2),
+                               rtol=1e-5, atol=1e-5)
+    g1 = jax.grad(lambda p: m1.apply(p, state, x, training=False)[0].sum()
+                  )(params)
+    g2 = jax.grad(lambda p: m2.apply(p, state, x, training=False)[0].sum()
+                  )(params)
+    np.testing.assert_allclose(
+        np.asarray(g1["conv_stem"]), np.asarray(g2["conv_stem"]),
+        rtol=1e-4, atol=1e-4)
+
+
+def test_max_pool2d_routed_backward_matches_select_and_scatter():
+    """Routed maxpool backward ≡ XLA SelectAndScatter gradient, incl.
+    first-wins tie routing (tie-heavy int-valued inputs)."""
+    import numpy as np
+    from jax import lax
+
+    from apex_tpu.ops.pooling import max_pool2d
+
+    def ref(x):
+        return lax.reduce_window(x, -jnp.inf, lax.max, (1, 3, 3, 1),
+                                 (1, 2, 2, 1), "SAME")
+
+    for seed, tie_heavy in ((0, False), (1, True)):
+        k = jax.random.PRNGKey(seed)
+        if tie_heavy:
+            # small-int grid + relu-style zeros → frequent exact ties
+            x = jax.random.randint(k, (2, 16, 16, 8), 0, 3).astype(
+                jnp.float32)
+        else:
+            x = jax.random.normal(k, (2, 16, 16, 8))
+        dy = jax.random.normal(jax.random.PRNGKey(seed + 9),
+                               ref(x).shape)
+        y1, vjp1 = jax.vjp(ref, x)
+        y2, vjp2 = jax.vjp(lambda x: max_pool2d(
+            x, routed_backward=True), x)
+        np.testing.assert_array_equal(np.asarray(y1), np.asarray(y2))
+        np.testing.assert_allclose(np.asarray(vjp1(dy)[0]),
+                                   np.asarray(vjp2(dy)[0]),
+                                   rtol=1e-6, atol=1e-6,
+                                   err_msg=f"tie_heavy={tie_heavy}")
+
+
+def test_max_pool2d_odd_sizes_and_valid():
+    import numpy as np
+    from jax import lax
+
+    from apex_tpu.ops.pooling import max_pool2d
+
+    x = jax.random.normal(jax.random.PRNGKey(3), (1, 13, 17, 4))
+    # only stride-(2,2) configs exercise the routed backward;
+    # max_pool2d silently falls back to reduce_window+XLA AD otherwise
+    for padding in ("SAME", "VALID"):
+        for window, strides in (((3, 3), (2, 2)), ((2, 2), (2, 2))):
+            def ref(x):
+                return lax.reduce_window(
+                    x, -jnp.inf, lax.max,
+                    (1,) + window + (1,), (1,) + strides + (1,), padding)
+
+            dy = jax.random.normal(jax.random.PRNGKey(4), ref(x).shape)
+            y1, vjp1 = jax.vjp(ref, x)
+            y2, vjp2 = jax.vjp(
+                lambda x: max_pool2d(x, window, strides, padding,
+                     routed_backward=True), x)
+            np.testing.assert_array_equal(np.asarray(y1), np.asarray(y2))
+            np.testing.assert_allclose(
+                np.asarray(vjp1(dy)[0]), np.asarray(vjp2(dy)[0]),
+                rtol=1e-6, atol=1e-6,
+                err_msg=f"{padding} {window} {strides}")
