@@ -79,9 +79,19 @@ class Policy:
         return out[0] if len(out) == 1 else out
 
     def compute_for(self, op_name: str):
-        """Compute dtype for a named op class, honoring the fp32 list."""
+        """Compute dtype for a named op class, honoring the fp32 list.
+
+        The allow (matmul) list wins over the fp32 list on compound
+        names — "einsum" is matmul-class even though it contains "sum"
+        (≡ the reference patches exact function objects, so its lists
+        can never collide; substring classification needs the
+        precedence).  Under O3 (keep_norm_fp32=False, the reference's
+        "pure half" mode with no patched casts, frontend.py:168-193)
+        fp32-class ops run in the compute dtype too."""
+        if any(k in op_name for k in MATMUL_CLASS_OPS):
+            return self.compute_dtype
         if any(k in op_name for k in FP32_CLASS_OPS):
-            return jnp.float32
+            return jnp.float32 if self.keep_norm_fp32 else self.compute_dtype
         return self.compute_dtype
 
 

@@ -187,14 +187,20 @@ def _dropout_keep(seed_ref, i, j, t, shape, rate):
     bits are a murmur-style hash of (seed, head, GLOBAL score
     coordinates) — a pure function of the element's identity, so any
     kernel (any grid order, any block size, interpret mode included)
-    reproduces it exactly.  The hardware PRNG
-    (pltpu.prng_random_bits) is NOT usable here: its stream→element
-    mapping follows each kernel's codegen, so forward and backward
-    kernels with different structure silently disagree (caught by the
-    examples/tpu_kernel_smoke.py dropout gate)."""
+    reproduces it exactly.  seed_ref rows 1 and 2 carry the chunk's
+    global (q, k) sequence offsets: a ring-attention chunk covering
+    global rows [q_off, q_off+s) x [k_off, k_off+s) generates the SAME
+    bits as single-chip attention over the gathered sequence, so
+    dropout composes across ring steps (fwd and bwd see one mask).
+    The hardware PRNG (pltpu.prng_random_bits) is NOT usable here: its
+    stream→element mapping follows each kernel's codegen, so forward
+    and backward kernels with different structure silently disagree
+    (caught by the examples/tpu_kernel_smoke.py dropout gate)."""
     bk, bq = shape
-    krow = t * bk + lax.broadcasted_iota(jnp.int32, shape, 0)  # k global
-    qcol = j * bq + lax.broadcasted_iota(jnp.int32, shape, 1)  # q global
+    krow = (seed_ref[2, 0] + t * bk
+            + lax.broadcasted_iota(jnp.int32, shape, 0))  # k global
+    qcol = (seed_ref[1, 0] + j * bq
+            + lax.broadcasted_iota(jnp.int32, shape, 1))  # q global
     h = seed_ref[0, 0] * jnp.int32(1000003) + jnp.int32(i)
     v = (h + krow * jnp.int32(-1640531535)       # 0x9e3779b1
          + qcol * jnp.int32(-2048144777))        # 0x85ebca77
@@ -202,6 +208,36 @@ def _dropout_keep(seed_ref, i, j, t, shape, rate):
     # integer-only compare (Mosaic has no uint32->f32 cast): clear the
     # sign bit for a uniform int32 in [0, 2^31) and threshold against
     # rate * 2^31
+    r = v & jnp.int32(0x7FFFFFFF)
+    thresh = jnp.int32(int(rate * 2147483648.0))
+    return r >= thresh
+
+
+def _seed3(seed, q_off=0, k_off=0):
+    """(3, 1) int32 seed operand: [seed, global q offset, global k
+    offset].  Accepts None, scalars, or legacy (1, 1) seed arrays;
+    offsets may be traced (ring steps pass rank/src-dependent values)."""
+    if seed is None:
+        seed = jnp.zeros((), jnp.int32)
+    seed = jnp.asarray(seed, jnp.int32).reshape(-1)[:1]
+    return jnp.stack([seed[0], jnp.asarray(q_off, jnp.int32),
+                      jnp.asarray(k_off, jnp.int32)]).reshape(3, 1)
+
+
+def dropout_keep_dense(seed, b, h, sq, sk, rate, q_off=0, k_off=0):
+    """Dense (b, h, sq, sk) keep mask — the SAME bits as the in-kernel
+    hash (i = flattened batch*head index), for the jnp blockwise paths
+    and parity tests."""
+    seed = jnp.asarray(seed, jnp.int32).reshape(-1)[:1][0]
+    i = jnp.arange(b * h, dtype=jnp.int32).reshape(b, h, 1, 1)
+    qcol = (jnp.asarray(q_off, jnp.int32)
+            + jnp.arange(sq, dtype=jnp.int32)).reshape(1, 1, sq, 1)
+    krow = (jnp.asarray(k_off, jnp.int32)
+            + jnp.arange(sk, dtype=jnp.int32)).reshape(1, 1, 1, sk)
+    v = (seed * jnp.int32(1000003) + i
+         + krow * jnp.int32(-1640531535)
+         + qcol * jnp.int32(-2048144777))
+    v = _fmix32(v)
     r = v & jnp.int32(0x7FFFFFFF)
     thresh = jnp.int32(int(rate * 2147483648.0))
     return r >= thresh
@@ -535,7 +571,7 @@ def _flatten_bh(x):
 
 def _fwd_impl(q, k, v, scale, causal, dropout_rate=0.0, seed=None,
               block_q=None, block_k=None, bias=None, q_seg=None,
-              kv_seg=None):
+              kv_seg=None, q_off=0, k_off=0):
     b, h, sq, d = q.shape
     sk = k.shape[2]
     bias_kind = _bias_kind(bias, sk)
@@ -544,8 +580,7 @@ def _fwd_impl(q, k, v, scale, causal, dropout_rate=0.0, seed=None,
     qf, kf, vf = _flatten_bh(q), _flatten_bh(k), _flatten_bh(v)
     bh = b * h
     nq, nk = sq // bq, sk // bk
-    if seed is None:
-        seed = jnp.zeros((1, 1), jnp.int32)
+    seed = _seed3(seed, q_off, k_off)
     has_seg = q_seg is not None
     nb = bias.shape[0] if bias is not None else 1
     nh = bias.shape[1] if bias is not None else 1
@@ -564,7 +599,7 @@ def _fwd_impl(q, k, v, scale, causal, dropout_rate=0.0, seed=None,
             pl.BlockSpec((1, bk, d), lambda i, j, t: (i, t, 0)),
             pl.BlockSpec((1, bk, d), lambda i, j, t: (i, t, 0)),
             bspec, qsspec, ksspec,
-            pl.BlockSpec((1, 1), lambda i, j, t: (0, 0)),
+            pl.BlockSpec((3, 1), lambda i, j, t: (0, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, bq, d), lambda i, j, t: (i, j, 0)),
@@ -603,7 +638,7 @@ def _head_row_spec(nq, bq):
 def _bwd_impl(q, k, v, o, lse, do, scale, causal, dropout_rate=0.0,
               seed=None, block_q=None, block_k=None, bias=None,
               q_seg=None, kv_seg=None, want_dbias=False,
-              grad_dtype=None):
+              grad_dtype=None, q_off=0, k_off=0):
     """Returns (dq, dk, dv, dbias) — dbias is None unless want_dbias.
 
     grad_dtype overrides the dq/dk/dv output dtype (default: the input
@@ -618,8 +653,7 @@ def _bwd_impl(q, k, v, o, lse, do, scale, causal, dropout_rate=0.0,
                               full_bias=bias_kind == "full")
     nq, nk = sq // bq, sk // bk
     bh = b * h
-    if seed is None:
-        seed = jnp.zeros((1, 1), jnp.int32)
+    seed = _seed3(seed, q_off, k_off)
     has_seg = q_seg is not None
     nb = bias.shape[0] if bias is not None else 1
     nh = bias.shape[1] if bias is not None else 1
@@ -642,7 +676,7 @@ def _bwd_impl(q, k, v, o, lse, do, scale, causal, dropout_rate=0.0,
     qspec = pl.BlockSpec((1, bq, d), lambda i, j, t: (i, j, 0))
     kspec = pl.BlockSpec((1, bk, d), lambda i, j, t: (i, t, 0))
     r1 = _head_row_spec(nq, bq)
-    sspec1 = pl.BlockSpec((1, 1), lambda i, j, t: (0, 0))
+    sspec1 = pl.BlockSpec((3, 1), lambda i, j, t: (0, 0))
 
     def _reduce_db(db_full):
         """(b, h, ...) per-head dbias partials → the caller's broadcast
@@ -724,7 +758,7 @@ def _bwd_impl(q, k, v, o, lse, do, scale, causal, dropout_rate=0.0,
     qspec2 = pl.BlockSpec((1, bq, d), lambda i, t, j: (i, j, 0))
     kspec2 = pl.BlockSpec((1, bk, d), lambda i, t, j: (i, t, 0))
     r2 = _head_row_spec(nq, bq)
-    sspec2 = pl.BlockSpec((1, 1), lambda i, t, j: (0, 0))
+    sspec2 = pl.BlockSpec((3, 1), lambda i, t, j: (0, 0))
     bspec2, qsspec2, ksspec2 = _extras_specs(
         h, nq, bq, nk, bk, bias_kind, nb, nh, has_seg,
         jt_from_args=lambda t, j: (j, t))

@@ -197,10 +197,7 @@ class DistributedFusedAdam(_ShardedFlat):
     def _gather_full(self, shard):
         """Bucket-aware param all-gather (one gather per bucket; the
         single-bucket case is the base layout exactly)."""
-        sync_dt = self.param_sync_dtype
-        if sync_dt is None:
-            dts = set(self.spec.dtypes)
-            sync_dt = dts.pop() if len(dts) == 1 else shard.dtype
+        sync_dt = self._param_sync_dt()
         pieces, off = [], 0
         for spec_i, padded_i in zip(self.bucket_specs,
                                     self._bucket_padded):
@@ -228,18 +225,32 @@ class DistributedFusedAdam(_ShardedFlat):
                 "differ")
         return super().load_state_dict(d)
 
+    def _param_sync_dt(self):
+        sync_dt = self.param_sync_dtype
+        if sync_dt is None:
+            dts = set(self.spec.dtypes)
+            sync_dt = dts.pop() if len(dts) == 1 else self.master_dtype
+        return sync_dt
+
     def step(self, state: DistributedFusedAdamState, grads, lr=None,
-             inv_scale=1.0, found_inf=False):
+             inv_scale=1.0, found_inf=False, gather_params=True):
         """grads: full (unsynced, per-dp-shard-of-batch) grad pytree.
         Returns (full params pytree, new state).  The reduce-scatter
-        averages over dp (≡ the reference's grad sync divide)."""
+        averages over dp (≡ the reference's grad sync divide).
+
+        The whole step runs PER BUCKET — reduce-scatter k, Adam k,
+        all-gather k — so XLA's scheduler can overlap bucket k's param
+        all-gather with bucket k+1's update math, ≡ the reference's
+        side-stream bucket pipeline (distributed_fused_adam.py:
+        1274-1571); with n_buckets=1 it degenerates to the fused form.
+
+        gather_params=False skips the all-gather and returns
+        (None, state): the caller reconstructs params at the NEXT
+        forward via `full_params(state)`, which lets XLA overlap the
+        gather with the start of forward compute instead of the tail of
+        the optimizer (the reference's param-sync-on-first-use mode)."""
         ax = self.axis_name
-        # ZeRO-2 core: per-bucket reduce-scatters replace DDP's
-        # allreduce; each starts as soon as ITS leaves' grads exist
-        g_shard = jnp.concatenate([
-            lax.psum_scatter(gb, ax, scatter_dimension=0, tiled=True)
-            / jnp.asarray(self.num_shards, gb.dtype)
-            for gb in self._bucket_flats(grads, self.grad_sync_dtype)])
+        rank = lax.axis_index(ax)
         found = jnp.asarray(found_inf)
         step_next = state.step + jnp.where(found, 0, 1).astype(jnp.int32)
         common = dict(
@@ -249,40 +260,113 @@ class DistributedFusedAdam(_ShardedFlat):
             adam_w_mode=self.adam_w_mode,
             bias_correction=self.bias_correction, inv_scale=inv_scale,
             found_inf=found, use_pallas_override=self.use_pallas)
-        if self._seg_wd is not None:
-            # per-leaf hyperparameters: one seg-kernel call per bucket
-            # shard (each is FLAT_TILE-aligned), with the shard's global
-            # row offset inside ITS bucket and the bucket's leaf range
-            # of the per-tensor vectors
-            rank = lax.axis_index(ax)
-            ps, ms, vs = [], [], []
-            off = 0
-            for (a, b), spec_i, padded_i in zip(
-                    self._ranges, self.bucket_specs, self._bucket_padded):
-                sz = padded_i // self.num_shards
-                sl = lambda arr: lax.dynamic_slice(arr, (off,), (sz,))
+        grad_buckets = self._bucket_flats(grads, self.grad_sync_dtype)
+        sync_dt = self._param_sync_dt()
+        ps, ms, vs = [], [], []
+        full_leaves = []
+        off = 0
+        for (a, b), spec_i, padded_i, gb in zip(
+                self._ranges, self.bucket_specs, self._bucket_padded,
+                grad_buckets):
+            sz = padded_i // self.num_shards
+            # ZeRO-2 core: per-bucket reduce-scatter — starts as soon
+            # as THIS bucket's leaves' grads exist
+            g_b = lax.psum_scatter(gb, ax, scatter_dimension=0,
+                                   tiled=True) / jnp.asarray(
+                self.num_shards, gb.dtype)
+
+            def sl(arr):
+                return lax.dynamic_slice(arr, (off,), (sz,))
+
+            if self._seg_wd is not None:
                 pi, mi, vi = K.adam_flat_seg(
                     sl(state.params_shard), sl(state.exp_avg),
-                    sl(state.exp_avg_sq), sl(g_shard),
+                    sl(state.exp_avg_sq), g_b,
                     wd_values=self._seg_wd[a:b],
                     lr_scale_values=self._seg_lrs[a:b],
                     spec=spec_i, row_offset=rank * (sz // K._LANES),
                     padded_total=padded_i, **common)
-                ps.append(pi)
-                ms.append(mi)
-                vs.append(vi)
-                off += sz
-            p = jnp.concatenate(ps)
-            m = jnp.concatenate(ms)
-            v = jnp.concatenate(vs)
-        else:
-            p, m, v = K.adam_flat(
-                state.params_shard, state.exp_avg, state.exp_avg_sq,
-                g_shard, weight_decay=self.weight_decay, **common)
+            else:
+                pi, mi, vi = K.adam_flat(
+                    sl(state.params_shard), sl(state.exp_avg),
+                    sl(state.exp_avg_sq), g_b,
+                    weight_decay=self.weight_decay, **common)
+            ps.append(pi)
+            ms.append(mi)
+            vs.append(vi)
+            if gather_params:
+                # bucket k's param all-gather depends only on ITS adam
+                # output → schedulable under bucket k+1's compute
+                full = lax.all_gather(pi.astype(sync_dt), ax, axis=0,
+                                      tiled=True)
+                full_leaves += jax.tree_util.tree_leaves(
+                    F.unflatten(full[: spec_i.total], spec_i))
+            off += sz
         new_state = DistributedFusedAdamState(
-            step=step_next, params_shard=p, exp_avg=m, exp_avg_sq=v)
-        # param all-gather ≡ the bucketed all-gather param sync
-        return self._gather_full(p), new_state
+            step=step_next, params_shard=jnp.concatenate(ps),
+            exp_avg=jnp.concatenate(ms), exp_avg_sq=jnp.concatenate(vs))
+        if not gather_params:
+            return None, new_state
+        return jax.tree_util.tree_unflatten(self.spec.treedef,
+                                            full_leaves), new_state
+
+    # ---- reshardable (gathered) checkpoints --------------------------------
+
+    def gather_state_dict(self, state) -> dict:
+        """Layout-independent checkpoint: all-gather every shard buffer
+        and unflatten to MODEL-TREE form, so state written at one
+        (num_shards, n_buckets) restores at any other.  Shard-local —
+        call inside shard_map.  ≡ the reference's state gather for
+        save (distributed_fused_adam.py:1274-1571 sharded_state_dict /
+        gather paths)."""
+        def tree_of(shard):
+            off = 0
+            out = []
+            for spec_i, padded_i in zip(self.bucket_specs,
+                                        self._bucket_padded):
+                sz = padded_i // self.num_shards
+                piece = lax.dynamic_slice(shard, (off,), (sz,))
+                full = lax.all_gather(piece, self.axis_name, axis=0,
+                                      tiled=True)
+                out += jax.tree_util.tree_leaves(
+                    F.unflatten(full[: spec_i.total], spec_i,
+                                cast_to_leaf_dtype=False))
+                off += sz
+            return jax.tree_util.tree_unflatten(self.spec.treedef, out)
+
+        return {"step": state.step,
+                "params": tree_of(state.params_shard),
+                "exp_avg": tree_of(state.exp_avg),
+                "exp_avg_sq": tree_of(state.exp_avg_sq)}
+
+    def load_gathered_state_dict(self, d: dict):
+        """Inverse of gather_state_dict under THIS optimizer's layout
+        (any num_shards / n_buckets / align).  Shard-local — call
+        inside shard_map after init() has fixed the layout."""
+        # gathered checkpoints carry model-tree "params"; layout-exact
+        # shard checkpoints carry "params_shard" (no string marker —
+        # the dict must be traceable through shard_map)
+        if "params" not in d or "params_shard" in d:
+            raise ValueError(
+                "not a gathered checkpoint — use load_state_dict for "
+                "layout-exact shard checkpoints")
+        if self.spec is None:
+            raise RuntimeError("call init(params) before "
+                               "load_gathered_state_dict()")
+        rank = lax.axis_index(self.axis_name)
+
+        def shard_of(tree):
+            flats = self._bucket_flats(tree, self.master_dtype)
+            return jnp.concatenate([
+                lax.dynamic_slice(f, (rank * (n // self.num_shards),),
+                                  (n // self.num_shards,))
+                for f, n in zip(flats, self._bucket_padded)])
+
+        return DistributedFusedAdamState(
+            step=jnp.asarray(d["step"], jnp.int32),
+            params_shard=shard_of(d["params"]),
+            exp_avg=shard_of(d["exp_avg"]),
+            exp_avg_sq=shard_of(d["exp_avg_sq"]))
 
 
 class DistributedFusedLAMBState(NamedTuple):
@@ -423,3 +507,43 @@ class DistributedFusedLAMB(_ShardedFlat):
         new_state = DistributedFusedLAMBState(
             step=step_next, params_shard=p, exp_avg=m, exp_avg_sq=v)
         return self._gather_full(p), new_state
+
+    def gather_state_dict(self, state) -> dict:
+        """Layout-independent checkpoint in model-tree form (see
+        DistributedFusedAdam.gather_state_dict); restores at any
+        num_shards.  Shard-local."""
+        def tree_of(shard):
+            full = lax.all_gather(shard, self.axis_name, axis=0,
+                                  tiled=True)
+            return F.unflatten(full[: self.spec.total], self.spec,
+                               cast_to_leaf_dtype=False)
+
+        return {"step": state.step,
+                "params": tree_of(state.params_shard),
+                "exp_avg": tree_of(state.exp_avg),
+                "exp_avg_sq": tree_of(state.exp_avg_sq)}
+
+    def load_gathered_state_dict(self, d: dict):
+        # gathered checkpoints carry model-tree "params"; layout-exact
+        # shard checkpoints carry "params_shard" (no string marker —
+        # the dict must be traceable through shard_map)
+        if "params" not in d or "params_shard" in d:
+            raise ValueError(
+                "not a gathered checkpoint — use load_state_dict for "
+                "layout-exact shard checkpoints")
+        if self.spec is None:
+            raise RuntimeError("call init(params) before "
+                               "load_gathered_state_dict()")
+        rank = lax.axis_index(self.axis_name)
+        shard_size = self.padded_total // self.num_shards
+
+        def shard_of(tree):
+            flat = self._flatten(tree, self.master_dtype)
+            return lax.dynamic_slice(flat, (rank * shard_size,),
+                                     (shard_size,))
+
+        return DistributedFusedLAMBState(
+            step=jnp.asarray(d["step"], jnp.int32),
+            params_shard=shard_of(d["params"]),
+            exp_avg=shard_of(d["exp_avg"]),
+            exp_avg_sq=shard_of(d["exp_avg_sq"]))

@@ -39,8 +39,11 @@ framework long context is first-class:
 
 Both compose with the TP layers (use a separate mesh axis or reuse
 "tp" when attention is not head-sharded).  In-kernel attention dropout
-is not offered on the ring path (the coordinate-hash stream is local to
-each chunk call; use dropout on the projections instead).
+works on the ring path too: each chunk hashes its GLOBAL (q, k)
+sequence offsets into the coordinate-hash keep mask, so all ring steps
+and the backward draw from ONE global mask — bit-identical to
+single-chip flash attention over the gathered sequence (tested in
+tests/test_context_parallel.py).
 """
 
 from __future__ import annotations
@@ -60,6 +63,7 @@ from apex_tpu.ops.flash_attention import (
     _bwd_impl,
     _fwd_impl,
     _pick_block,
+    dropout_keep_dense,
 )
 
 
@@ -75,10 +79,14 @@ def _jnp_blocks(sk, block_k):
     return bk, sk // bk
 
 
-def _chunk_fwd_jnp(q, k, v, scale, causal, q_seg, kv_seg, block_k):
+def _chunk_fwd_jnp(q, k, v, scale, causal, q_seg, kv_seg, block_k,
+                   dropout_rate=0.0, seed=None, q_off=0, k_off=0):
     """Blockwise online-softmax forward in plain jnp (the off-TPU stand-in
     for the Pallas kernel): scans k-blocks so peak score memory is
-    (sq × block_k), never (sq × sk).  Returns (o, lse)."""
+    (sq × block_k), never (sq × sk).  Returns (o, lse).  Dropout uses
+    the kernel's global-coordinate hash (dropout_keep_dense), masking p
+    before the deferred 1/l normalization (the l denominator stays the
+    raw softmax sum, ≡ _fwd_kernel)."""
     b, h, sq, d = q.shape
     sk = k.shape[2]
     bk, nk = _jnp_blocks(sk, block_k)
@@ -101,7 +109,14 @@ def _chunk_fwd_jnp(q, k, v, scale, causal, q_seg, kv_seg, block_k):
         p = jnp.exp(s - m_new[..., None])
         alpha = jnp.exp(m - m_new)
         l_new = l * alpha + jnp.sum(p, axis=-1)
-        o_new = o * alpha[..., None] + jnp.einsum("bhqk,bhkd->bhqd", p, v_t)
+        if dropout_rate > 0.0:
+            keep = dropout_keep_dense(seed, b, h, sq, bk, dropout_rate,
+                                      q_off, k_off + t * bk)
+            p_acc = jnp.where(keep, p, 0.0) / (1.0 - dropout_rate)
+        else:
+            p_acc = p
+        o_new = o * alpha[..., None] + jnp.einsum("bhqk,bhkd->bhqd",
+                                                  p_acc, v_t)
         return (m_new, l_new, o_new), None
 
     m0 = jnp.full((b, h, sq), _NEG_INF, jnp.float32)
@@ -113,9 +128,14 @@ def _chunk_fwd_jnp(q, k, v, scale, causal, q_seg, kv_seg, block_k):
 
 
 def _chunk_bwd_jnp(q, k, v, do, lse, delta, scale, causal, q_seg, kv_seg,
-                   block_k):
+                   block_k, dropout_rate=0.0, seed=None, q_off=0,
+                   k_off=0):
     """Blockwise backward against the GLOBAL (lse, delta) — the partials
-    this produces sum across ring steps to the exact gradient."""
+    this produces sum across ring steps to the exact gradient.  Dropout
+    regenerates the forward's coordinate-hash mask (≡ _bwd_dkv_kernel:
+    dv uses dropped p, dp is masked before ds)."""
+    b, h = q.shape[0], q.shape[1]
+    sq = q.shape[2]
     sk = k.shape[2]
     bk, nk = _jnp_blocks(sk, block_k)
     q32 = q.astype(jnp.float32)
@@ -135,10 +155,18 @@ def _chunk_bwd_jnp(q, k, v, do, lse, delta, scale, causal, q_seg, kv_seg,
             s = jnp.where(kpos[None, :] > qpos[:, None], _NEG_INF, s)
         p = jnp.exp(s - lse[..., None])                    # global-normalized
         dp = jnp.einsum("bhqd,bhkd->bhqk", do32, v_t)
+        if dropout_rate > 0.0:
+            keep = dropout_keep_dense(seed, b, h, sq, bk, dropout_rate,
+                                      q_off, k_off + t * bk)
+            inv = 1.0 / (1.0 - dropout_rate)
+            p_v = jnp.where(keep, p, 0.0) * inv
+            dp = jnp.where(keep, dp, 0.0) * inv
+        else:
+            p_v = p
         ds = p * (dp - delta[..., None])
         dq = dq + scale * jnp.einsum("bhqk,bhkd->bhqd", ds, k_t)
         dk_t = scale * jnp.einsum("bhqk,bhqd->bhkd", ds, q32)
-        dv_t = jnp.einsum("bhqk,bhqd->bhkd", p, do32)
+        dv_t = jnp.einsum("bhqk,bhqd->bhkd", p_v, do32)
         return dq, (dk_t, dv_t)
 
     dq0 = jnp.zeros(q.shape[:3] + (q.shape[3],), jnp.float32)
@@ -150,26 +178,33 @@ def _chunk_bwd_jnp(q, k, v, do, lse, delta, scale, causal, q_seg, kv_seg,
 
 
 def _chunk_fwd(q, k, v, scale, causal, q_seg, kv_seg, block_q, block_k,
-               pallas_path):
+               pallas_path, dropout_rate=0.0, seed=None, q_off=0,
+               k_off=0):
     if pallas_path:
-        return _fwd_impl(q, k, v, scale, causal, 0.0, None, block_q,
-                         block_k, None, q_seg, kv_seg)
-    return _chunk_fwd_jnp(q, k, v, scale, causal, q_seg, kv_seg, block_k)
+        return _fwd_impl(q, k, v, scale, causal, dropout_rate, seed,
+                         block_q, block_k, None, q_seg, kv_seg,
+                         q_off=q_off, k_off=k_off)
+    return _chunk_fwd_jnp(q, k, v, scale, causal, q_seg, kv_seg, block_k,
+                          dropout_rate, seed, q_off, k_off)
 
 
 def _chunk_bwd(q, k, v, o, lse, delta, do, scale, causal, q_seg, kv_seg,
-               block_q, block_k, pallas_path):
+               block_q, block_k, pallas_path, dropout_rate=0.0,
+               seed=None, q_off=0, k_off=0):
     if pallas_path:
         # fp32 partials straight from the kernel: per-ring-step grads
         # accumulate across hops at full precision and round to the
         # input dtype ONCE at the end (ADVICE r4 — bf16-per-hop rounding
         # degraded with ring size)
         dq, dk, dv, _ = _bwd_impl(q, k, v, o, lse, do, scale, causal,
-                                  0.0, None, block_q, block_k, None,
-                                  q_seg, kv_seg, grad_dtype=jnp.float32)
+                                  dropout_rate, seed, block_q, block_k,
+                                  None, q_seg, kv_seg,
+                                  grad_dtype=jnp.float32,
+                                  q_off=q_off, k_off=k_off)
         return dq, dk, dv
     return _chunk_bwd_jnp(q, k, v, do, lse, delta, scale, causal,
-                          q_seg, kv_seg, block_k)
+                          q_seg, kv_seg, block_k, dropout_rate, seed,
+                          q_off, k_off)
 
 
 # ------------------------------- ring core ----------------------------------
@@ -199,21 +234,25 @@ def _rotate(axis_name, n, tree):
         lambda x: lax.ppermute(x, axis_name, perm), tree)
 
 
-def _ring_fwd_impl(q, k, v, q_seg, kv_seg, axis_name, causal, scale,
-                   block_q, block_k, pallas_path):
+def _ring_fwd_impl(q, k, v, q_seg, kv_seg, seed, axis_name, causal,
+                   scale, block_q, block_k, pallas_path, dropout_rate):
     b, h, s, d = q.shape
     n = lax.axis_size(axis_name)
     rank = lax.axis_index(axis_name)
     has_seg = q_seg is not None
 
-    def attend(k_c, v_c, kseg_c, diag):
-        return _chunk_fwd(q, k_c, v_c, scale, causal and diag, q_seg,
-                          kseg_c, block_q, block_k, pallas_path)
-
     def step(carry, i):
         o_acc, lse_acc, k_c, v_c, kseg_c = carry
         src = (rank - i) % n
         kseg_arg = kseg_c if has_seg else None
+
+        def attend(k_c, v_c, kseg_c, diag):
+            # global offsets make the coordinate-hash dropout mask agree
+            # across ring steps AND with single-chip attention over the
+            # gathered sequence
+            return _chunk_fwd(q, k_c, v_c, scale, causal and diag, q_seg,
+                              kseg_c, block_q, block_k, pallas_path,
+                              dropout_rate, seed, rank * s, src * s)
         if causal:
             # strictly-above-diagonal chunks (src > rank) are fully
             # masked: the skip branch runs NO score work — a causal
@@ -248,36 +287,35 @@ def _ring_fwd_impl(q, k, v, q_seg, kv_seg, axis_name, causal, scale,
     return o.astype(q.dtype), lse
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10))
-def _ring(q, k, v, q_seg, kv_seg, axis_name, causal, scale, block_q,
-          block_k, pallas_path):
-    o, _ = _ring_fwd_impl(q, k, v, q_seg, kv_seg, axis_name, causal,
-                          scale, block_q, block_k, pallas_path)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(6, 7, 8, 9, 10, 11,
+                                                    12))
+def _ring(q, k, v, q_seg, kv_seg, seed, axis_name, causal, scale,
+          block_q, block_k, pallas_path, dropout_rate):
+    o, _ = _ring_fwd_impl(q, k, v, q_seg, kv_seg, seed, axis_name,
+                          causal, scale, block_q, block_k, pallas_path,
+                          dropout_rate)
     return o
 
 
-def _ring_vjp_fwd(q, k, v, q_seg, kv_seg, axis_name, causal, scale,
-                  block_q, block_k, pallas_path):
-    o, lse = _ring_fwd_impl(q, k, v, q_seg, kv_seg, axis_name, causal,
-                            scale, block_q, block_k, pallas_path)
+def _ring_vjp_fwd(q, k, v, q_seg, kv_seg, seed, axis_name, causal,
+                  scale, block_q, block_k, pallas_path, dropout_rate):
+    o, lse = _ring_fwd_impl(q, k, v, q_seg, kv_seg, seed, axis_name,
+                            causal, scale, block_q, block_k, pallas_path,
+                            dropout_rate)
     # residuals are O(s_local · d) per device — blockwise recompute in
     # backward replaces AD-through-scan's O(n · s_local²) saved scores
-    return o, (q, k, v, q_seg, kv_seg, o, lse)
+    return o, (q, k, v, q_seg, kv_seg, seed, o, lse)
 
 
 def _ring_vjp_bwd(axis_name, causal, scale, block_q, block_k, pallas_path,
-                  res, do):
-    q, k, v, q_seg, kv_seg, o, lse = res
+                  dropout_rate, res, do):
+    q, k, v, q_seg, kv_seg, seed, o, lse = res
+    s = q.shape[2]
     n = lax.axis_size(axis_name)
     rank = lax.axis_index(axis_name)
     has_seg = q_seg is not None
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
     zero_kd = jnp.zeros(k.shape, jnp.float32)
-
-    def partials(k_c, v_c, kseg_c, diag):
-        return _chunk_bwd(q, k_c, v_c, o, lse, delta, do, scale,
-                          causal and diag, q_seg, kseg_c, block_q,
-                          block_k, pallas_path)
 
     def step(carry, i):
         # dk/dv accumulators TRAVEL with their kv chunk: after n
@@ -286,6 +324,12 @@ def _ring_vjp_bwd(axis_name, causal, scale, block_q, block_k, pallas_path,
         dq_acc, k_c, v_c, kseg_c, dk_c, dv_c = carry
         src = (rank - i) % n
         kseg_arg = kseg_c if has_seg else None
+
+        def partials(k_c, v_c, kseg_c, diag):
+            return _chunk_bwd(q, k_c, v_c, o, lse, delta, do, scale,
+                              causal and diag, q_seg, kseg_c, block_q,
+                              block_k, pallas_path, dropout_rate, seed,
+                              rank * s, src * s)
         if causal:
             def do_skip(_):
                 return (jnp.zeros(q.shape, jnp.float32), zero_kd, zero_kd)
@@ -315,7 +359,7 @@ def _ring_vjp_bwd(axis_name, causal, scale, block_q, block_k, pallas_path,
               zero_kd, zero_kd)
     (dq, _, _, _, dk, dv), _ = lax.scan(step, carry0, jnp.arange(n))
     return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype),
-            _int_zero(q_seg), _int_zero(kv_seg))
+            _int_zero(q_seg), _int_zero(kv_seg), _int_zero(seed))
 
 
 _ring.defvjp(_ring_vjp_fwd, _ring_vjp_bwd)
@@ -373,8 +417,8 @@ def _halves(x, half, axis=2):
     return lo, hi
 
 
-def _ring_fwd_zigzag(q, k, v, q_seg, kv_seg, axis_name, scale,
-                     block_q, block_k, pallas_path):
+def _ring_fwd_zigzag(q, k, v, q_seg, kv_seg, seed, axis_name, scale,
+                     block_q, block_k, pallas_path, dropout_rate):
     b, h, s, d = q.shape
     half = s // 2
     n = lax.axis_size(axis_name)
@@ -382,41 +426,52 @@ def _ring_fwd_zigzag(q, k, v, q_seg, kv_seg, axis_name, scale,
     has_seg = q_seg is not None
     q_a, q_b = _halves(q, half)
     qs_a, qs_b = _halves(q_seg, half, axis=1)
+    # GLOBAL half-chunk offsets (zigzag order: device r owns halves
+    # (r, 2n-1-r)) feed the coordinate-hash dropout so the mask agrees
+    # across steps and with the gathered-sequence single-chip mask
+    qo_a = rank * half
+    qo_b = (2 * n - 1 - rank) * half
 
-    def attend(qh, qsh, kh, vh, ksh, causal_flag):
+    def attend(qh, qsh, kh, vh, ksh, causal_flag, q_off, k_off):
         return _chunk_fwd(qh, kh, vh, scale, causal_flag, qsh, ksh,
-                          block_q, block_k, pallas_path)
+                          block_q, block_k, pallas_path, dropout_rate,
+                          seed, q_off, k_off)
 
-    def gated(idx, o_acc, l_acc, qh, qsh, kh, vh, ksh):
+    def gated(idx, o_acc, l_acc, qh, qsh, kh, vh, ksh, q_off, k_off):
         """idx: 0 skip, 1 diag (causal), 2 full."""
         def do_skip(_):
             return o_acc, l_acc
 
         def do_diag(_):
             return _merge(o_acc, l_acc, *attend(qh, qsh, kh, vh, ksh,
-                                                True))
+                                                True, q_off, k_off))
 
         def do_full(_):
             return _merge(o_acc, l_acc, *attend(qh, qsh, kh, vh, ksh,
-                                                False))
+                                                False, q_off, k_off))
 
         return lax.switch(idx, (do_skip, do_diag, do_full), None)
 
     def step(carry, i):
         o_a, l_a, o_b, l_b, k_c, v_c, kseg_c = carry
         src = (rank - i) % n
+        ko_lo = src * half
+        ko_hi = (2 * n - 1 - src) * half
         k_lo, k_hi = _halves(k_c, half)
         v_lo, v_hi = _halves(v_c, half)
         ks_lo, ks_hi = _halves(kseg_c if has_seg else None, half, axis=1)
         # (b, c): unconditionally full
         o_b, l_b = _merge(o_b, l_b,
-                          *attend(q_b, qs_b, k_lo, v_lo, ks_lo, False))
+                          *attend(q_b, qs_b, k_lo, v_lo, ks_lo, False,
+                                  qo_b, ko_lo))
         # (a, c)
         idx_ac = jnp.where(src > rank, 0, jnp.where(src == rank, 1, 2))
-        o_a, l_a = gated(idx_ac, o_a, l_a, q_a, qs_a, k_lo, v_lo, ks_lo)
+        o_a, l_a = gated(idx_ac, o_a, l_a, q_a, qs_a, k_lo, v_lo, ks_lo,
+                         qo_a, ko_lo)
         # (b, d)
         idx_bd = jnp.where(src < rank, 0, jnp.where(src == rank, 1, 2))
-        o_b, l_b = gated(idx_bd, o_b, l_b, q_b, qs_b, k_hi, v_hi, ks_hi)
+        o_b, l_b = gated(idx_bd, o_b, l_b, q_b, qs_b, k_hi, v_hi, ks_hi,
+                         qo_b, ko_hi)
         k_c, v_c = _rotate(axis_name, n, (k_c, v_c))
         if has_seg:
             kseg_c = _rotate(axis_name, n, kseg_c)
@@ -432,24 +487,26 @@ def _ring_fwd_zigzag(q, k, v, q_seg, kv_seg, axis_name, scale,
     return o, lse
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
-def _ring_zz(q, k, v, q_seg, kv_seg, axis_name, scale, block_q,
-             block_k, pallas_path):
-    o, _ = _ring_fwd_zigzag(q, k, v, q_seg, kv_seg, axis_name, scale,
-                            block_q, block_k, pallas_path)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(6, 7, 8, 9, 10, 11))
+def _ring_zz(q, k, v, q_seg, kv_seg, seed, axis_name, scale, block_q,
+             block_k, pallas_path, dropout_rate):
+    o, _ = _ring_fwd_zigzag(q, k, v, q_seg, kv_seg, seed, axis_name,
+                            scale, block_q, block_k, pallas_path,
+                            dropout_rate)
     return o
 
 
-def _ring_zz_vjp_fwd(q, k, v, q_seg, kv_seg, axis_name, scale, block_q,
-                     block_k, pallas_path):
-    o, lse = _ring_fwd_zigzag(q, k, v, q_seg, kv_seg, axis_name, scale,
-                              block_q, block_k, pallas_path)
-    return o, (q, k, v, q_seg, kv_seg, o, lse)
+def _ring_zz_vjp_fwd(q, k, v, q_seg, kv_seg, seed, axis_name, scale,
+                     block_q, block_k, pallas_path, dropout_rate):
+    o, lse = _ring_fwd_zigzag(q, k, v, q_seg, kv_seg, seed, axis_name,
+                              scale, block_q, block_k, pallas_path,
+                              dropout_rate)
+    return o, (q, k, v, q_seg, kv_seg, seed, o, lse)
 
 
 def _ring_zz_vjp_bwd(axis_name, scale, block_q, block_k, pallas_path,
-                     res, do):
-    q, k, v, q_seg, kv_seg, o, lse = res
+                     dropout_rate, res, do):
+    q, k, v, q_seg, kv_seg, seed, o, lse = res
     half = q.shape[2] // 2
     n = lax.axis_size(axis_name)
     rank = lax.axis_index(axis_name)
@@ -462,51 +519,58 @@ def _ring_zz_vjp_bwd(axis_name, scale, block_q, block_k, pallas_path,
     qs_a, qs_b = _halves(q_seg, half, axis=1)
     lse_a, lse_b = _halves(lse, half, axis=2)
     d_a, d_b = _halves(delta, half, axis=2)
+    qo_a = rank * half
+    qo_b = (2 * n - 1 - rank) * half
     # q and kv shards share (b, h, half, d) — one zero serves the skip
     # branch's dq, dk, and dv partials
     zero_half = jnp.zeros(q_a.shape, jnp.float32)
-
-    def partials(qh, qsh, oh, lh, dh, doh, kh, vh, ksh, causal_flag):
-        return _chunk_bwd(qh, kh, vh, oh, lh, dh, doh, scale,
-                          causal_flag, qsh, ksh, block_q, block_k,
-                          pallas_path)
-
-    def gated(idx, *args):
-        def do_skip(_):
-            return zero_half, zero_half, zero_half
-
-        def do_diag(_):
-            return partials(*args, True)
-
-        def do_full(_):
-            return partials(*args, False)
-
-        return lax.switch(idx, (do_skip, do_diag, do_full), None)
 
     def step(carry, i):
         (dq_a, dq_b, k_c, v_c, kseg_c,
          dk_lo, dk_hi, dv_lo, dv_hi) = carry
         src = (rank - i) % n
+        ko_lo = src * half
+        ko_hi = (2 * n - 1 - src) * half
         k_lo, k_hi = _halves(k_c, half)
         v_lo, v_hi = _halves(v_c, half)
         ks_lo, ks_hi = _halves(kseg_c if has_seg else None, half, axis=1)
+
+        def partials(qh, qsh, oh, lh, dh, doh, kh, vh, ksh, causal_flag,
+                     q_off, k_off):
+            return _chunk_bwd(qh, kh, vh, oh, lh, dh, doh, scale,
+                              causal_flag, qsh, ksh, block_q, block_k,
+                              pallas_path, dropout_rate, seed, q_off,
+                              k_off)
+
+        def gated(idx, *args):
+            def do_skip(_):
+                return zero_half, zero_half, zero_half
+
+            def do_diag(_):
+                return partials(*args[:-2], True, *args[-2:])
+
+            def do_full(_):
+                return partials(*args[:-2], False, *args[-2:])
+
+            return lax.switch(idx, (do_skip, do_diag, do_full), None)
+
         # (b, c): unconditionally full
         p_q, p_k, p_v = partials(q_b, qs_b, o_b, lse_b, d_b, do_b,
-                                 k_lo, v_lo, ks_lo, False)
+                                 k_lo, v_lo, ks_lo, False, qo_b, ko_lo)
         dq_b = dq_b + p_q
         dk_lo = dk_lo + p_k
         dv_lo = dv_lo + p_v
         # (a, c)
         idx_ac = jnp.where(src > rank, 0, jnp.where(src == rank, 1, 2))
         p_q, p_k, p_v = gated(idx_ac, q_a, qs_a, o_a, lse_a, d_a, do_a,
-                              k_lo, v_lo, ks_lo)
+                              k_lo, v_lo, ks_lo, qo_a, ko_lo)
         dq_a = dq_a + p_q
         dk_lo = dk_lo + p_k
         dv_lo = dv_lo + p_v
         # (b, d)
         idx_bd = jnp.where(src < rank, 0, jnp.where(src == rank, 1, 2))
         p_q, p_k, p_v = gated(idx_bd, q_b, qs_b, o_b, lse_b, d_b, do_b,
-                              k_hi, v_hi, ks_hi)
+                              k_hi, v_hi, ks_hi, qo_b, ko_hi)
         dq_b = dq_b + p_q
         dk_hi = dk_hi + p_k
         dv_hi = dv_hi + p_v
@@ -526,7 +590,7 @@ def _ring_zz_vjp_bwd(axis_name, scale, block_q, block_k, pallas_path,
     dk = jnp.concatenate([dk_lo, dk_hi], axis=2)
     dv = jnp.concatenate([dv_lo, dv_hi], axis=2)
     return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype),
-            _int_zero(q_seg), _int_zero(kv_seg))
+            _int_zero(q_seg), _int_zero(kv_seg), _int_zero(seed))
 
 
 _ring_zz.defvjp(_ring_zz_vjp_fwd, _ring_zz_vjp_bwd)
@@ -541,6 +605,8 @@ def ring_attention(q, k, v, axis_name: str, *, causal: bool = False,
                    layout: str = "contiguous",
                    block_q: Optional[int] = None,
                    block_k: Optional[int] = None,
+                   dropout_rate: float = 0.0,
+                   dropout_key=None,
                    use_pallas_override: Optional[bool] = None):
     """Blockwise ring attention (see module docstring for the design).
 
@@ -557,6 +623,13 @@ def ring_attention(q, k, v, axis_name: str, *, causal: bool = False,
     the contiguous layout, whose last rank computes at every step (see
     the zigzag section above).  Non-causal attention has no positional
     structure to balance — use the default layout.
+
+    dropout_rate / dropout_key: in-kernel attention dropout.  The
+    coordinate-hash keep mask uses each chunk's GLOBAL (q, k) offsets,
+    so every ring step (and the backward) sees one consistent global
+    mask — identical bits to single-chip flash attention over the
+    gathered sequence with the same key.  Pass the SAME key on every
+    device (it is replicated state, like the params).
     """
     if layout not in ("contiguous", "zigzag"):
         raise ValueError(f"unknown layout {layout!r}")
@@ -571,6 +644,12 @@ def ring_attention(q, k, v, axis_name: str, *, causal: bool = False,
     if (q_segment_ids is None) != (kv_segment_ids is None):
         raise ValueError("q_segment_ids and kv_segment_ids go together")
     b, s = q.shape[0], q.shape[2]
+    seed = None
+    if dropout_rate > 0.0:
+        if dropout_key is None:
+            raise ValueError("dropout_rate > 0 needs a dropout_key")
+        seed = jax.random.randint(dropout_key, (1, 1), -2 ** 31,
+                                  2 ** 31 - 1, dtype=jnp.int32)
     if q_segment_ids is not None:
         q_segment_ids = jnp.asarray(q_segment_ids, jnp.int32)
         kv_segment_ids = jnp.asarray(kv_segment_ids, jnp.int32)
@@ -589,12 +668,14 @@ def ring_attention(q, k, v, axis_name: str, *, causal: bool = False,
             raise ValueError("zigzag needs an even local sequence")
         pallas_path = bool(use_pallas(use_pallas_override)
                            and _pick_block(s // 2))
-        return _ring_zz(q, k, v, q_segment_ids, kv_segment_ids,
-                        axis_name, scale, block_q, block_k, pallas_path)
+        return _ring_zz(q, k, v, q_segment_ids, kv_segment_ids, seed,
+                        axis_name, scale, block_q, block_k, pallas_path,
+                        float(dropout_rate))
     pallas_path = bool(use_pallas(use_pallas_override)
                        and _pick_block(s))
-    return _ring(q, k, v, q_segment_ids, kv_segment_ids, axis_name,
-                 causal, scale, block_q, block_k, pallas_path)
+    return _ring(q, k, v, q_segment_ids, kv_segment_ids, seed, axis_name,
+                 causal, scale, block_q, block_k, pallas_path,
+                 float(dropout_rate))
 
 
 def ulysses_attention(q, k, v, axis_name: str, *, causal: bool = False,

@@ -1,0 +1,43 @@
+"""GPT-1.3B single-chip config sweep (VERDICT r4 next-#3: 13.2k flat
+for two rounds; target >= 15.2k tok/s ~= 60% MFU).
+
+Dials: seq 512 vs 1024, batch, remat policy (dots / attn-only / off).
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+import bench
+from apex_tpu.models.gpt import GPT2_1p3B, GPTConfig
+
+
+def point(name, batch, seq, remat, policy):
+    cfg = GPTConfig(vocab_size=50304, seq_len=seq, dropout=0.0,
+                    dtype=jnp.bfloat16, logits_dtype=jnp.bfloat16,
+                    remat=remat, remat_policy=policy,
+                    use_flash_attention=True, **GPT2_1p3B)
+    try:
+        tps = bench._fused_tokens_per_sec(True, batch, seq, cfg,
+                                          master_dtype=jnp.bfloat16)
+        print(f"{name:<28} b{batch} s{seq}: {tps:,.0f} tok/s", flush=True)
+    except Exception as e:
+        print(f"{name:<28} b{batch} s{seq}: FAIL {repr(e)[:90]}",
+              flush=True)
+
+
+if __name__ == "__main__":
+    which = sys.argv[1] if len(sys.argv) > 1 else "a"
+    if which == "a":
+        point("current (dots remat)", 8, 512, True, "dots")
+        point("s1024 dots", 4, 1024, True, "dots")
+        point("s1024 dots b6", 6, 1024, True, "dots")
+        point("s512 no-remat", 4, 512, False, None)
+    elif which == "b":
+        point("s512 b12 dots", 12, 512, True, "dots")
+        point("s1024 b8 dots", 8, 1024, True, "dots")
+        point("s512 b8 names:ffn1", 8, 512, True, "names:ffn1")
+        point("s512 b6 no-remat", 6, 512, False, None)

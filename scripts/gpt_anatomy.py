@@ -1,0 +1,149 @@
+"""Per-component GPT step anatomy (VERDICT r4 next-#3/#8): attribute
+the missing MFU to specific ops by timing sub-programs in-jit
+(slope-timed scans, dispatch-amortized).
+
+Components at the bench configs (350M: b12 s1024; 1.3B: b8 s512):
+  * embed + LM head + softmax-xent loss (fwd+bwd)
+  * one transformer layer's attention sublayer (fwd+bwd) x L
+  * one transformer layer's MLP sublayer (fwd+bwd) x L
+  * full model step (the reference point)
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+PEAK = 197e12
+
+
+def _scan_time(fn, args, iters=50, reps=3):
+    def make(length):
+        def many(*a):
+            def body(carry, _):
+                out = fn(*((a[0] + carry.astype(a[0].dtype),) + a[1:]))
+                return sum(jnp.sum(l.astype(jnp.float32))
+                           for l in jax.tree.leaves(out)) * 1e-30, None
+            c, _ = lax.scan(body, jnp.zeros((), jnp.float32), None,
+                            length=length)
+            return c
+        return jax.jit(many)
+
+    def total(f):
+        _ = np.asarray(f(*args))
+        best = np.inf
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            _ = np.asarray(f(*args))
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    lo, hi = max(1, iters // 5), iters
+    return (total(make(hi)) - total(make(lo))) / (hi - lo)
+
+
+def anatomy(name, hidden, layers, heads, batch, seq, vocab=50304):
+    print(f"--- {name}: h{hidden} L{layers} H{heads} b{batch} s{seq}",
+          flush=True)
+    key = jax.random.PRNGKey(0)
+    d = hidden // heads
+    x = jax.random.normal(key, (batch, seq, hidden), jnp.bfloat16)
+
+    # attention sublayer: qkv proj + flash + out proj
+    from apex_tpu.ops.flash_attention import flash_attention
+    wqkv = jax.random.normal(key, (hidden, 3 * hidden), jnp.bfloat16) * 0.02
+    wo = jax.random.normal(key, (hidden, hidden), jnp.bfloat16) * 0.02
+
+    def attn(x, wqkv, wo):
+        qkv = x @ wqkv
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+
+        def heads_of(t):
+            return t.reshape(batch, seq, heads, d).transpose(0, 2, 1, 3)
+
+        o = flash_attention(heads_of(q), heads_of(k), heads_of(v),
+                            causal=True)
+        o = o.transpose(0, 2, 1, 3).reshape(batch, seq, hidden)
+        return o @ wo
+
+    def attn_fb(x, wqkv, wo):
+        out, vjp = jax.vjp(attn, x, wqkv, wo)
+        return (out,) + vjp(out)
+
+    t_attn = _scan_time(attn_fb, (x, wqkv, wo), iters=20)
+    fl_attn = (2 * batch * seq * hidden * 4 * hidden       # proj
+               + 2 * batch * heads * seq * seq * d * 2) * 3  # sdpa
+    print(f"attn sublayer fwd+bwd: {t_attn*1e3:7.3f} ms x{layers} = "
+          f"{t_attn*layers*1e3:7.1f} ms  ({fl_attn/t_attn/1e12:.0f} TF/s"
+          f" {100*fl_attn/t_attn/PEAK:.0f}%pk)", flush=True)
+
+    # MLP sublayer
+    w1 = jax.random.normal(key, (hidden, 4 * hidden), jnp.bfloat16) * 0.02
+    w2 = jax.random.normal(key, (4 * hidden, hidden), jnp.bfloat16) * 0.02
+
+    def mlp(x, w1, w2):
+        return (jax.nn.gelu(x @ w1)) @ w2
+
+    def mlp_fb(x, w1, w2):
+        out, vjp = jax.vjp(mlp, x, w1, w2)
+        return (out,) + vjp(out)
+
+    t_mlp = _scan_time(mlp_fb, (x, w1, w2), iters=20)
+    fl_mlp = 2 * batch * seq * hidden * 8 * hidden * 3
+    print(f"mlp  sublayer fwd+bwd: {t_mlp*1e3:7.3f} ms x{layers} = "
+          f"{t_mlp*layers*1e3:7.1f} ms  ({fl_mlp/t_mlp/1e12:.0f} TF/s "
+          f"{100*fl_mlp/t_mlp/PEAK:.0f}%pk)", flush=True)
+
+    # LM head + loss (tied embedding matmul + xent)
+    from apex_tpu.ops.xentropy import softmax_cross_entropy_loss
+    emb = jax.random.normal(key, (vocab, hidden), jnp.bfloat16) * 0.02
+    labels = jax.random.randint(key, (batch, seq), 0, vocab)
+
+    def head(x, emb):
+        logits = (x @ emb.T).astype(jnp.bfloat16)
+        return jnp.mean(softmax_cross_entropy_loss(
+            logits.reshape(-1, vocab), labels.reshape(-1)))
+
+    def head_fb(x, emb):
+        out, vjp = jax.vjp(head, x, emb)
+        return (out,) + vjp(jnp.ones_like(out))
+
+    t_head = _scan_time(head_fb, (x, emb), iters=10)
+    fl_head = 2 * batch * seq * hidden * vocab * 3
+    print(f"LM head + xent fwd+bwd: {t_head*1e3:6.3f} ms          "
+          f"({fl_head/t_head/1e12:.0f} TF/s "
+          f"{100*fl_head/t_head/PEAK:.0f}%pk)", flush=True)
+
+    # LayerNorm stack (2 per layer + final)
+    from apex_tpu.ops.layer_norm import fused_layer_norm
+    g = jnp.ones((hidden,))
+    bb = jnp.zeros((hidden,))
+
+    def ln_fb(x, g, bb):
+        out, vjp = jax.vjp(lambda x, g, bb: fused_layer_norm(x, g, bb),
+                           x, g, bb)
+        return (out,) + vjp(out)
+
+    t_ln = _scan_time(ln_fb, (x, g, bb), iters=50)
+    n_ln = 2 * layers + 1
+    print(f"layernorm fwd+bwd:     {t_ln*1e3:7.3f} ms x{n_ln} = "
+          f"{t_ln*n_ln*1e3:7.1f} ms", flush=True)
+
+    model_sum = (t_attn + t_mlp) * layers + t_head + t_ln * n_ln
+    tot_fl = (fl_attn + fl_mlp) * layers + fl_head
+    print(f"component sum: {model_sum*1e3:.1f} ms "
+          f"({batch*seq/model_sum:,.0f} tok/s if additive; "
+          f"model flops {tot_fl/1e12:.1f} TF)", flush=True)
+
+
+if __name__ == "__main__":
+    which = sys.argv[1] if len(sys.argv) > 1 else "both"
+    if which in ("350m", "both"):
+        anatomy("GPT-350M", 1024, 24, 16, 12, 1024)
+    if which in ("1p3b", "both"):
+        anatomy("GPT-1.3B", 2048, 24, 32, 8, 512)

@@ -1,0 +1,185 @@
+"""Per-op AMP cast/promote matrix ≡ tests/L0/run_amp/test_basic_casts.py
++ test_promotion.py (VERDICT r4 next-#9).
+
+The reference pins its O1 patching engine op by op: allow-list ops
+(conv/mm/...) run half, promote-list ops (softmax/norm/loss) run fp32,
+and mixed-dtype inputs promote to the widest type.  apex_tpu's AMP is a
+policy object applied at call sites, so the same contract is pinned
+table-driven against `Policy.compute_for` (the cast-list encoding,
+amp/policy.py MATMUL_CLASS_OPS / FP32_CLASS_OPS) and functionally
+against the real kernels (internal fp32 for fp32-class ops on bf16
+inputs).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu import amp
+from apex_tpu.amp.policy import (
+    FP32_CLASS_OPS,
+    MATMUL_CLASS_OPS,
+    get_policy,
+)
+
+BF16 = jnp.bfloat16
+F32 = jnp.float32
+
+# (opt_level, matmul-class dtype, fp32-class dtype) ≡ the reference
+# opt_levels table (frontend.py:104-193): O0 pure fp32; O1/O2 patched /
+# master-weight half with fp32 promote list; O3 pure half.
+MATRIX = [
+    ("O0", F32, F32),
+    ("O1", BF16, F32),
+    ("O2", BF16, F32),
+    ("O3", BF16, BF16),
+]
+
+
+@pytest.mark.parametrize("opt_level,matmul_dt,fp32_dt", MATRIX)
+@pytest.mark.parametrize("op", MATMUL_CLASS_OPS)
+def test_matmul_class_compute_dtype(opt_level, matmul_dt, fp32_dt, op):
+    """≡ test_basic_casts' whitelist loop (conv/mm/matmul run half)."""
+    assert get_policy(opt_level).compute_for(op) == matmul_dt
+
+
+@pytest.mark.parametrize("opt_level,matmul_dt,fp32_dt", MATRIX)
+@pytest.mark.parametrize("op", FP32_CLASS_OPS)
+def test_fp32_class_compute_dtype(opt_level, matmul_dt, fp32_dt, op):
+    """≡ test_basic_casts' fp32-list loop (softmax/norm/loss stay
+    fp32 under O1/O2; pure-half under O3)."""
+    assert get_policy(opt_level).compute_for(op) == fp32_dt
+
+
+def test_compound_names_use_fp32_class():
+    """Compound op names hit the fp32 list by substring (the reference
+    patches functions, which carry their class in the name)."""
+    p = get_policy("O1")
+    assert p.compute_for("fused_layer_norm") == F32
+    assert p.compute_for("masked_softmax") == F32
+    assert p.compute_for("fused_dense") == BF16
+    assert p.compute_for("flash_attention") == BF16
+
+
+@pytest.mark.parametrize("opt_level,param_dt,out_dt", [
+    ("O0", F32, F32), ("O1", F32, F32), ("O2", BF16, F32),
+    ("O3", BF16, BF16),
+])
+def test_param_and_output_dtypes(opt_level, param_dt, out_dt):
+    """≡ cast_model_type / cast_model_outputs rows of the opt_levels
+    table (frontend.py:104-193)."""
+    p = get_policy(opt_level)
+    assert p.param_dtype == param_dt
+    assert p.output_dtype == out_dt
+
+
+def test_promotion_widest_type():
+    """≡ test_promotion.py: binary ops on mixed half/fp32 inputs run in
+    (promote to) fp32.  Functionally: cast_to_compute leaves dtypes
+    uniform, and jnp's own promotion picks fp32 for mixed operands —
+    the policy never downcasts an fp32 operand implicitly."""
+    a16 = jnp.ones((4, 4), BF16)
+    a32 = jnp.ones((4, 4), F32)
+    assert (a16 + a32).dtype == F32
+    assert jnp.matmul(a16, a32).dtype == F32
+    # cast_to_compute under O1 makes everything bf16 (explicit, not
+    # implicit) — ints / bools are untouched
+    p = get_policy("O1")
+    tree = {"w": a32, "mask": jnp.ones((4,), jnp.int32)}
+    out = p.cast_to_compute(tree)
+    assert out["w"].dtype == BF16
+    assert out["mask"].dtype == jnp.int32
+
+
+# ---------------- functional: real kernels honor the contract --------------
+
+
+def test_layer_norm_internal_fp32():
+    """fp32-class op: bf16 input, bf16 output, fp32-accurate stats —
+    the kernel must match the fp32 oracle to bf16 resolution, not to
+    bf16-stats resolution."""
+    from apex_tpu.ops.layer_norm import fused_layer_norm
+
+    x32 = jax.random.normal(jax.random.PRNGKey(0), (64, 256)) * 100.0
+    x16 = x32.astype(BF16)
+    g = jnp.ones((256,))
+    b = jnp.zeros((256,))
+    y16 = fused_layer_norm(x16, g, b)
+    assert y16.dtype == BF16
+    y_oracle = fused_layer_norm(x32, g, b)
+    np.testing.assert_allclose(np.asarray(y16, np.float32),
+                               np.asarray(y_oracle), rtol=2e-2,
+                               atol=2e-2)
+
+
+def test_softmax_internal_fp32():
+    from apex_tpu.transformer.functional.fused_softmax import (
+        FusedScaleMaskSoftmax,
+    )
+
+    sm = FusedScaleMaskSoftmax()
+    x = (jax.random.normal(jax.random.PRNGKey(1), (2, 2, 32, 32))
+         * 30.0).astype(BF16)
+    y = sm(x)
+    assert y.dtype == BF16
+    s = np.asarray(jnp.sum(y.astype(F32), axis=-1))
+    np.testing.assert_allclose(s, np.ones_like(s), rtol=2e-2, atol=2e-2)
+
+
+def test_xentropy_loss_fp32():
+    """Loss-class op returns fp32 regardless of logits dtype."""
+    from apex_tpu.ops.xentropy import softmax_cross_entropy_loss
+
+    logits = jax.random.normal(jax.random.PRNGKey(2), (8, 128)).astype(
+        BF16)
+    labels = jax.random.randint(jax.random.PRNGKey(3), (8,), 0, 128)
+    loss = softmax_cross_entropy_loss(logits, labels)
+    assert loss.dtype == F32
+
+
+def test_batch_stats_fp32():
+    """norm-class statistics accumulate fp32 on bf16 activations."""
+    from apex_tpu.ops import welford
+
+    x = (jax.random.normal(jax.random.PRNGKey(4), (32, 8, 8, 16))
+         + 10.0).astype(BF16)
+    mean, var, count = welford.batch_stats(x, (0, 1, 2))
+    assert mean.dtype == F32 and var.dtype == F32
+    np.testing.assert_allclose(
+        np.asarray(mean), np.asarray(x.astype(F32)).mean((0, 1, 2)),
+        rtol=1e-2, atol=1e-2)
+    assert np.all(np.asarray(var) >= 0)  # sumsq-mean² in bf16 would go
+    # negative at mean>>std
+
+
+def test_matmul_class_runs_bf16_under_o1():
+    """The O1-cast train path really computes matmul-class ops in bf16:
+    params cast to compute dtype → dense output is bf16."""
+    from apex_tpu.ops.fused_dense import linear_bias
+
+    p = get_policy("O1")
+    w = jax.random.normal(jax.random.PRNGKey(5), (32, 16))
+    b = jnp.zeros((16,))
+    x = jax.random.normal(jax.random.PRNGKey(6), (4, 32))
+    wc, bc, xc = p.cast_to_compute((w, b, x))
+    assert wc.dtype == BF16
+    y = linear_bias(xc, wc, bc)
+    assert y.dtype == BF16
+
+
+def test_o2_master_weights_roundtrip():
+    """O2 keeps fp32 masters next to bf16 model params
+    (≡ _initialize.py:178-203 + fp16_utils master flow)."""
+    from apex_tpu.amp.policy import (
+        master_params_to_model_params,
+        prep_param_lists,
+    )
+
+    params = {"w": jax.random.normal(jax.random.PRNGKey(7), (8, 8),
+                                     dtype=F32).astype(BF16)}
+    model_p, master = prep_param_lists(params)
+    assert master["w"].dtype == F32
+    updated = jax.tree.map(lambda m: m + 0.5, master)
+    back = master_params_to_model_params(updated, model_p)
+    assert back["w"].dtype == BF16

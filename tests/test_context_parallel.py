@@ -358,3 +358,100 @@ def test_ulysses_segment_ids(causal, use_flash):
                                q_segment_ids=seg, kv_segment_ids=seg)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=1e-4, atol=1e-4)
+
+
+# ----------------------- ring-path attention dropout --------------------
+
+
+@pytest.mark.parametrize("use_pallas", [True, False])
+@pytest.mark.parametrize("layout", ["contiguous", "zigzag"])
+def test_ring_dropout_matches_single_chip_flash(layout, use_pallas):
+    """Ring dropout uses global-coordinate hashing, so with the same
+    key the ring output must EQUAL single-chip flash attention over the
+    gathered sequence — forward and gradients (VERDICT r4 next-#6).
+    use_pallas=False drives the jnp blockwise chunk path, whose
+    dropout_keep_dense mask is bit-identical to the kernel hash."""
+    from apex_tpu.ops.flash_attention import flash_attention
+    from apex_tpu.parallel.context_parallel import (zigzag_shard,
+                                                    zigzag_unshard)
+
+    mesh = M.initialize_model_parallel(tensor_model_parallel_size=N)
+    q, k, v = _qkv(1, 2, 128, 16, seed=31)
+    key = jax.random.PRNGKey(7)
+    rate = 0.3
+
+    def local(q, k, v):
+        def loss(q, k, v):
+            o = ring_attention(q, k, v, "tp", causal=True, layout=layout,
+                               dropout_rate=rate, dropout_key=key,
+                               use_pallas_override=use_pallas)
+            return jnp.sum(o.astype(jnp.float32) ** 2)
+
+        o = ring_attention(q, k, v, "tp", causal=True, layout=layout,
+                           dropout_rate=rate, dropout_key=key,
+                           use_pallas_override=use_pallas)
+        return (o,) + jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+
+    spec = P(None, None, "tp")
+    if layout == "zigzag":
+        args = tuple(zigzag_shard(x, N) for x in (q, k, v))
+    else:
+        args = (q, k, v)
+    o, gq, gk, gv = jax.jit(shard_map(
+        local, mesh=mesh, in_specs=(spec,) * 3, out_specs=(spec,) * 4,
+        check_vma=False))(*args)
+    if layout == "zigzag":
+        o, gq, gk, gv = (zigzag_unshard(x, N) for x in (o, gq, gk, gv))
+
+    # single-chip oracle with the SAME key: global-coordinate hashing
+    # makes the masks identical
+    def chip_loss(q, k, v):
+        o = flash_attention(q, k, v, causal=True, dropout_rate=rate,
+                            dropout_key=key, use_pallas_override=True)
+        return jnp.sum(o.astype(jnp.float32) ** 2)
+
+    o_ref = flash_attention(q, k, v, causal=True, dropout_rate=rate,
+                            dropout_key=key, use_pallas_override=True)
+    g_ref = jax.grad(chip_loss, argnums=(0, 1, 2))(q, k, v)
+    np.testing.assert_allclose(np.asarray(o, np.float32),
+                               np.asarray(o_ref, np.float32),
+                               rtol=2e-4, atol=2e-4)
+    for a, e, nm in zip((gq, gk, gv), g_ref, "qkv"):
+        np.testing.assert_allclose(np.asarray(a, np.float32),
+                                   np.asarray(e, np.float32),
+                                   rtol=2e-3, atol=2e-3,
+                                   err_msg=f"d{nm} {layout}")
+
+
+def test_ring_dropout_distribution_and_jnp_path():
+    """jnp (non-pallas) ring path: dropout drops ~rate of attention
+    mass and is deterministic per key; fwd is reproducible."""
+    mesh = M.initialize_model_parallel(tensor_model_parallel_size=N)
+    q, k, v = _qkv(1, 2, 128, 16, seed=33)
+    key = jax.random.PRNGKey(9)
+
+    def run(rate, key):
+        f = shard_map(
+            lambda q, k, v: ring_attention(
+                q, k, v, "tp", causal=False, dropout_rate=rate,
+                dropout_key=key, use_pallas_override=False),
+            mesh=mesh, in_specs=(P(None, None, "tp"),) * 3,
+            out_specs=P(None, None, "tp"), check_vma=False)
+        return jax.jit(f)(q, k, v)
+
+    o1 = run(0.4, key)
+    o2 = run(0.4, key)
+    np.testing.assert_array_equal(np.asarray(o1), np.asarray(o2))
+    o3 = run(0.4, jax.random.PRNGKey(10))
+    assert np.abs(np.asarray(o1, np.float32)
+                  - np.asarray(o3, np.float32)).max() > 1e-4
+    # no dropout path unchanged by a passed key
+    o4 = run(0.0, key)
+    o5 = run(0.0, jax.random.PRNGKey(10))
+    np.testing.assert_array_equal(np.asarray(o4), np.asarray(o5))
+
+
+def test_ring_dropout_needs_key():
+    q, k, v = _qkv(1, 2, 32, 8, seed=35)
+    with pytest.raises(ValueError, match="dropout_key"):
+        ring_attention(q, k, v, "tp", dropout_rate=0.1)
