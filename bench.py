@@ -214,16 +214,18 @@ def _mha_latencies(on_tpu):
 
 
 def _gpt1p3b_tokens_per_sec(on_tpu):
-    """1.3B single-chip config (VERDICT r2 #1): h2048 L24 H32, batch 8 x
+    """1.3B single-chip config (VERDICT r2 #1): h2048 L24 H32, batch 7 x
     seq 512, bf16 Adam state (p+m+v at 6 B/param fits one 16 GB chip),
-    'dots' selective remat, bf16 LM-head logits.  Swept in round 3
-    (docs/PERF.md 1.3B anatomy): 13.0k tok/s ~= 52% MFU on v5e."""
+    NO remat (b7 activations fit; the round-5 sweep: b8 dots 13.24k,
+    b8 no-remat 13.17k, b7 no-remat 13.35k, names:all5 13.13k — the
+    step is component-bound, not remat-bound; docs/PERF.md anatomy),
+    bf16 LM-head logits."""
     from apex_tpu.models.gpt import GPT2_1p3B, GPTConfig
     if on_tpu:
-        batch, seq = 8, 512
+        batch, seq = 7, 512
         cfg = GPTConfig(vocab_size=50304, seq_len=seq, dropout=0.0,
                         dtype=jnp.bfloat16, logits_dtype=jnp.bfloat16,
-                        remat=True, remat_policy="dots",
+                        remat=False,
                         use_flash_attention=True, **GPT2_1p3B)
     else:
         batch, seq = 2, 64
@@ -331,7 +333,7 @@ def _resnet50_img_per_sec(on_tpu):
                                with_state=True)
     x = jax.random.normal(jax.random.PRNGKey(1), (batch, size, size, 3))
     y = jax.random.randint(jax.random.PRNGKey(2), (batch,), 0, 1000)
-    iters, warmup = (10, 2) if on_tpu else (2, 1)
+    iters, warmup = (20, 3) if on_tpu else (2, 1)
     for _ in range(warmup):
         state, scaler, mstate, loss = step(state, scaler, mstate, (x, y))
     _ = np.asarray(loss)
@@ -342,6 +344,32 @@ def _resnet50_img_per_sec(on_tpu):
     dt = (time.perf_counter() - t0) / iters
     M.destroy_model_parallel()
     return batch / dt
+
+
+def _long_context_32k(on_tpu):
+    """32k-token causal flash attention fwd+bwd on one chip (B1 H8 D64)
+    — the long-context kernel north star (VERDICT r4 next-#4; dense
+    attention cannot represent this: the bf16 score matrix alone would
+    be 17 GB).  Returns (ms, tokens/s)."""
+    from apex_tpu.ops.flash_attention import flash_attention
+
+    B, H, S, D = (1, 8, 32768, 64) if on_tpu else (1, 2, 1024, 32)
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q, k, v = (jax.random.normal(kk, (B, H, S, D), jnp.bfloat16)
+               for kk in ks)
+
+    g = jax.jit(jax.grad(
+        lambda q, k, v: flash_attention(q, k, v, causal=True).astype(
+            jnp.float32).mean(), argnums=(0, 1, 2)))
+    out = g(q, k, v)
+    _ = np.asarray(out[0].ravel()[0])
+    iters = 5 if on_tpu else 2
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = g(q, k, v)
+    _ = np.asarray(out[0].ravel()[0])
+    dt = (time.perf_counter() - t0) / iters
+    return dt * 1e3, B * S / dt
 
 
 def _adam_1b_step_ms(on_tpu):
@@ -436,6 +464,12 @@ def main():
             _retry(_adam_1b_step_ms, on_tpu), 2)
     except Exception as e:
         result["adam_1b_error"] = repr(e)[:120]
+    try:
+        lc_ms, lc_tps = _retry(_long_context_32k, on_tpu)
+        result["long_context_32k_fwd_bwd_ms"] = round(lc_ms, 1)
+        result["long_context_32k_tokens_per_sec"] = round(lc_tps, 1)
+    except Exception as e:
+        result["long_context_error"] = repr(e)[:120]
     print(json.dumps(result))
 
 

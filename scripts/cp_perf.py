@@ -1,0 +1,130 @@
+"""Context-parallelism perf measurements (VERDICT r4 next-#4).
+
+Modes:
+  chip — real-TPU, single chip: monolithic 32k flash fwd+bwd vs the
+    same work issued as ring-style (s_local x s_local) chunk calls —
+    quantifies the per-chunk overhead of the ring's repeated _fwd_impl
+    invocations and the block-skipping efficiency lost to chunking.
+  mesh — 8-device virtual CPU mesh: contiguous vs zigzag causal ring
+    step time (the load-balance claim; each virtual device is an XLA
+    host thread, so the imbalanced contiguous ring's straggler shows
+    up in wall-clock).
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def chip():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from apex_tpu.ops.flash_attention import flash_attention
+
+    B, H, S, D = 1, 8, 32768, 64
+    n = 8
+    s_local = S // n
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q, k, v = (jax.random.normal(kk, (B, H, S, D), jnp.bfloat16)
+               for kk in ks)
+
+    def timeit(f, *args, iters=5):
+        out = f(*args)
+        _ = np.asarray(jax.tree.leaves(out)[0].ravel()[0])
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = f(*args)
+        _ = np.asarray(jax.tree.leaves(out)[0].ravel()[0])
+        return (time.perf_counter() - t0) / iters
+
+    mono = jax.jit(jax.grad(
+        lambda q, k, v: flash_attention(q, k, v, causal=True).astype(
+            jnp.float32).mean(), argnums=(0, 1, 2)))
+    t_mono = timeit(mono, q, k, v)
+    print(f"monolithic 32k causal flash fwd+bwd: {t_mono*1e3:8.1f} ms",
+          flush=True)
+
+    # ring-style chunking on ONE chip: every (rank, src) chunk pair a
+    # causal n=8 ring would run — (n²+n)/2 chunk calls of
+    # (s_local x s_local), diagonal ones causal — then summed grads.
+    # Matches the ring's total chunk work (spread over n devices).
+    def chunked(q, k, v):
+        def loss(q, k, v):
+            total = 0.0
+            for r in range(n):
+                qs = jax.lax.dynamic_slice_in_dim(q, r * s_local,
+                                                  s_local, 2)
+                for src in range(r + 1):
+                    kss = jax.lax.dynamic_slice_in_dim(k, src * s_local,
+                                                       s_local, 2)
+                    vs = jax.lax.dynamic_slice_in_dim(v, src * s_local,
+                                                      s_local, 2)
+                    o = flash_attention(qs, kss, vs, causal=(src == r))
+                    total = total + o.astype(jnp.float32).mean()
+            return total
+
+        return jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+
+    t_chunk = timeit(jax.jit(chunked), q, k, v, iters=3)
+    n_calls = n * (n + 1) // 2
+    print(f"chunked ({n_calls} ring-chunk calls):  {t_chunk*1e3:8.1f} ms"
+          f"  ({(t_chunk-t_mono)/n_calls*1e3:+.2f} ms/chunk overhead vs "
+          "monolithic)", flush=True)
+    print(f"per-device ring critical path ~ {t_chunk/n*1e3:.1f} ms "
+          f"(contiguous worst rank ~ {t_chunk*2/n*1e3:.1f})", flush=True)
+
+
+def mesh():
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 8)
+    import jax.numpy as jnp
+    import numpy as np
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from apex_tpu.parallel import mesh as M
+    from apex_tpu.parallel.context_parallel import (
+        ring_attention,
+        zigzag_shard,
+    )
+
+    N = 8
+    msh = M.initialize_model_parallel(tensor_model_parallel_size=N)
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    S = 8192
+    q, k, v = (jax.random.normal(kk, (1, 2, S, 64), jnp.float32)
+               for kk in ks)
+
+    def run(layout):
+        args = (tuple(zigzag_shard(x, N) for x in (q, k, v))
+                if layout == "zigzag" else (q, k, v))
+        f = jax.jit(shard_map(
+            lambda q, k, v: ring_attention(q, k, v, "tp", causal=True,
+                                           layout=layout),
+            mesh=msh, in_specs=(P(None, None, "tp"),) * 3,
+            out_specs=P(None, None, "tp"), check_vma=False))
+        out = f(*args)
+        _ = np.asarray(out.ravel()[0])
+        t0 = time.perf_counter()
+        for _ in range(5):
+            out = f(*args)
+        _ = np.asarray(out.ravel()[0])
+        return (time.perf_counter() - t0) / 5
+
+    t_c = run("contiguous")
+    t_z = run("zigzag")
+    print(f"8-way virtual mesh, {S}-token causal ring fwd: "
+          f"contiguous {t_c*1e3:.1f} ms vs zigzag {t_z*1e3:.1f} ms "
+          f"({t_c/t_z:.2f}x)", flush=True)
+
+
+if __name__ == "__main__":
+    which = sys.argv[1] if len(sys.argv) > 1 else "chip"
+    if which == "chip":
+        chip()
+    else:
+        mesh()

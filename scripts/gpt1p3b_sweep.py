@@ -41,3 +41,13 @@ if __name__ == "__main__":
         point("s1024 b8 dots", 8, 1024, True, "dots")
         point("s512 b8 names:ffn1", 8, 512, True, "names:ffn1")
         point("s512 b6 no-remat", 6, 512, False, None)
+    elif which == "c":
+        point("names:all5", 8, 512, True,
+              "names:qkv,attn_ctx,attn_out,ffn1,ffn_out")
+        point("names:attn_ctx+ffn1", 8, 512, True,
+              "names:attn_ctx,ffn1")
+        point("names:all5 b10", 10, 512, True,
+              "names:qkv,attn_ctx,attn_out,ffn1,ffn_out")
+    elif which == "d":
+        point("s512 b8 no-remat", 8, 512, False, None)
+        point("s512 b7 no-remat", 7, 512, False, None)

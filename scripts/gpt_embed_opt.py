@@ -1,0 +1,100 @@
+"""The unmeasured 1.3B step components: embedding gather fwd+bwd (TPU
+scatter-add suspect) vs a one-hot-matmul backward, and the fused Adam
+pass at 1.3B scale."""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+V, H = 50304, 2048
+B, S = 8, 512
+
+
+def _scan_time(fn, args, iters=30, reps=3):
+    def make(length):
+        def many(*a):
+            def body(carry, _):
+                out = fn(*((a[0] + carry.astype(a[0].dtype),) + a[1:]))
+                return sum(jnp.sum(l.astype(jnp.float32))
+                           for l in jax.tree.leaves(out)) * 1e-30, None
+            c, _ = lax.scan(body, jnp.zeros((), jnp.float32), None,
+                            length=length)
+            return c
+        return jax.jit(many)
+
+    def total(f):
+        _ = np.asarray(f(*args))
+        best = np.inf
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            _ = np.asarray(f(*args))
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    lo, hi = max(1, iters // 5), iters
+    return (total(make(hi)) - total(make(lo))) / (hi - lo)
+
+
+emb = jax.random.normal(jax.random.PRNGKey(0), (V, H), jnp.bfloat16) * 0.02
+tok = jax.random.randint(jax.random.PRNGKey(1), (B, S), 0, V)
+dy = jax.random.normal(jax.random.PRNGKey(2), (B, S, H), jnp.bfloat16)
+
+
+def take_fb(emb, tok, dy):
+    out, vjp = jax.vjp(lambda e: jnp.take(e, tok, axis=0), emb)
+    return out, vjp(dy)[0]
+
+
+t = _scan_time(take_fb, (emb, tok, dy), iters=10)
+print(f"embed take fwd + scatter-add bwd: {t*1e3:8.3f} ms", flush=True)
+
+
+def onehot_fb(emb, tok, dy):
+    # bwd of take is a scatter; expressing dE = onehot^T @ dy turns it
+    # into one MXU matmul
+    def f(e):
+        return jnp.take(e, tok, axis=0)
+
+    out = f(emb)
+    oh = jax.nn.one_hot(tok.reshape(-1), V, dtype=jnp.bfloat16)
+    dE = jax.lax.dot_general(oh, dy.reshape(-1, H).astype(jnp.bfloat16),
+                             (((0,), (0,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    return out, dE
+
+
+t = _scan_time(onehot_fb, (emb, tok, dy), iters=10)
+print(f"embed take fwd + one-hot-matmul bwd: {t*1e3:5.3f} ms", flush=True)
+
+# fused Adam at 1.3B bf16 state (the bench's optimizer tail)
+from apex_tpu.ops import optimizer_kernels as K
+
+n = (1_300_000_000 // K.FLAT_TILE + 1) * K.FLAT_TILE
+p = jnp.zeros((n,), jnp.bfloat16)
+m = jnp.zeros((n,), jnp.bfloat16)
+v = jnp.zeros((n,), jnp.bfloat16)
+g = jnp.full((n,), 1e-3, jnp.bfloat16)
+
+
+def adam(p, m, v, g):
+    return K.adam_flat(p, m, v, g, lr=1e-3, step=10.0,
+                       use_pallas_override=True)
+
+
+jstep = jax.jit(adam, donate_argnums=(0, 1, 2))
+args = (p, m, v)
+for _ in range(2):
+    args = jstep(*args, g)
+_ = np.asarray(args[0][:1])
+t0 = time.perf_counter()
+for _ in range(10):
+    args = jstep(*args, g)
+_ = np.asarray(args[0][:1])
+t = (time.perf_counter() - t0) / 10
+print(f"adam 1.3B bf16 p/m/v step: {t*1e3:14.3f} ms", flush=True)

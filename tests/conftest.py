@@ -72,8 +72,10 @@ SMOKE = {
 
 # L1 tier (≡ the reference's tests/L1 heavy suites): the measured-slow
 # tests (≥14 s serial; durations from a full --durations run) that push
-# the default run past the 10-minute budget.  Every file keeps lighter
-# siblings in the default (L0) tier; `pytest -m l1` runs these.
+# the default run past the budget.  Most files keep lighter siblings in
+# the default (L0) tier (the cross-product file is l1 wholesale; its
+# default-tier coverage lives in test_amp_casts.py + the e2e model
+# tests); `pytest -m l1` runs these.
 L1 = {
     "test_context_parallel.py::test_ring_attention_128k_causal_fwd_bwd",
     "test_distributed_optimizers.py::"
@@ -86,14 +88,9 @@ L1 = {
     "test_bert_minimal.py::test_bert_loss_consistent_across_tp",
     "test_bert_minimal.py::test_bert_flash_vs_dense_attention_parity",
     "test_bert_minimal.py::test_bert_pad_mask",
-    "test_l1_cross_product.py::test_config_trains[O0]",
-    "test_l1_cross_product.py::test_config_trains[O1]",
-    "test_l1_cross_product.py::test_config_trains[O1_adam]",
-    "test_l1_cross_product.py::test_config_trains[O1_noscale]",
-    "test_l1_cross_product.py::test_config_trains[O1_static128]",
-    "test_l1_cross_product.py::test_config_trains[O2]",
-    "test_l1_cross_product.py::test_config_trains[O2_nokeepbn]",
-    "test_l1_cross_product.py::test_config_trains[O3]",
+    # (all of test_l1_cross_product.py is l1 via its module-level
+    # pytestmark — round 5 moved the parity half there too, restoring
+    # the default tier's runtime margin)
     "test_gpt_pipelined.py::test_pipelined_matches_plain",
     "test_gpt_pipelined.py::test_pipelined_interleaved_matches",
     "test_gpt_pipelined.py::test_pipelined_grads_flow",

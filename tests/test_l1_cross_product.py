@@ -12,6 +12,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
+
+# the cross-product matrix is the heavy tier by definition (pytest.ini:
+# "l1: heavy tier (large-scale / cross-product tests)"); the default
+# tier's AMP coverage lives in test_amp_casts.py + the e2e model tests
+pytestmark = pytest.mark.l1
 from jax.sharding import PartitionSpec as P
 
 from apex_tpu import amp

@@ -133,7 +133,8 @@ def test_max_pool2d_odd_sizes_and_valid():
 
     x = jax.random.normal(jax.random.PRNGKey(3), (1, 13, 17, 4))
     # only stride-(2,2) configs exercise the routed backward;
-    # max_pool2d silently falls back to reduce_window+XLA AD otherwise
+    # max_pool2d falls back to reduce_window+XLA AD otherwise (so a
+    # stride-(1,1) case here would compare the fallback with itself)
     for padding in ("SAME", "VALID"):
         for window, strides in (((3, 3), (2, 2)), ((2, 2), (2, 2))):
             def ref(x):
