@@ -14,3 +14,7 @@ from apex_tpu.transformer.pipeline_parallel.common import (  # noqa: F401
     build_model,
     get_params_for_weight_decay_optimization,
 )
+from apex_tpu.transformer.pipeline_parallel.host_driver import (  # noqa: F401
+    HostPipelineStage,
+    host_pipeline_train_step,
+)

@@ -1,0 +1,194 @@
+"""Host-driven (MPMD) pipeline: per-stage jitted programs, 1F1B from
+the host — the multi-slice design.
+
+≡ the reference's schedule engine running OUTSIDE the compiled graph:
+forward_backward_pipelining_without_interleaving drives per-stage
+modules from Python, moving activations with batched isend/irecv
+(apex/transformer/pipeline_parallel/schedules/
+fwd_bwd_pipelining_without_interleaving.py + p2p_communication.py:
+385-690).  The SPMD schedule in schedules.py compiles the WHOLE
+pipeline into one program with `ppermute` hops — ideal within an ICI
+domain; a DCN-spanning (multi-slice / multi-host) pipeline cannot live
+in one program, so this driver is the second design SURVEY §7 names:
+
+  * each stage is its OWN jitted (fwd, bwd) pair, pinned to its device
+    (one slice / host in production; distinct devices of the local
+    platform here);
+  * activations/cotangents cross stages as host-initiated
+    `jax.device_put` transfers (≡ the NCCL send/recv pairs; over DCN
+    this is where the transfer library plugs in);
+  * the host runs a dependency-driven 1F1B: ready backwards first
+    (later stages first, so cotangents flow a hop per sweep), then
+    ready forwards, with a HARD per-stage in-flight cap of
+    n_stage - i saved inputs — the exact 1F1B activation bound (the
+    last stage never holds more than one), asserted per stage in
+    tests/test_host_pipeline.py;
+  * dispatch is async — device k executes microbatch m's forward while
+    device k-1 already runs m+1 — so the host loop pipelines for real
+    even though it is plain Python.
+
+The backward of a stage is recompute-based: bwd_i(params, x, dy)
+re-runs the stage forward under jax.vjp inside ONE jitted program (the
+standard remat trade: no cross-program residuals need to move between
+fwd and bwd programs beyond the saved stage INPUT).
+
+Gradient accumulation across microbatches happens on each stage's own
+device; the final per-stage grads never leave their slice (the
+optimizer is expected to be stage-local, ≡ the reference where each
+rank's optimizer owns its stage's params).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+
+class HostPipelineStage:
+    """One pipeline stage: `apply(params, x) -> y` compiled twice —
+    forward-only and forward+vjp — and pinned to `device`.  The LAST
+    stage's apply must return a scalar loss (positional contract, as in
+    the reference's schedule engine)."""
+
+    def __init__(self, apply_fn: Callable, device=None):
+        self.apply_fn = apply_fn
+        self.device = device
+
+        def fwd(params, x):
+            return apply_fn(params, x)
+
+        def bwd(params, x, dy):
+            y, vjp = jax.vjp(apply_fn, params, x)
+            dparams, dx = vjp(dy)
+            return dparams, dx
+
+        def loss_bwd(params, x):
+            # last stage: scalar loss; seed cotangent 1.0
+            loss, vjp = jax.vjp(apply_fn, params, x)
+            dparams, dx = vjp(jnp.ones_like(loss))
+            return loss, dparams, dx
+
+        # placement comes from the COMMITTED inputs (put() pins both
+        # params and activations to this stage's device), not from the
+        # deprecated jit(device=...) argument
+        self._fwd = jax.jit(fwd)
+        self._bwd = jax.jit(bwd)
+        self._loss_bwd = jax.jit(loss_bwd)
+        self._accum = jax.jit(
+            lambda acc, g: jax.tree_util.tree_map(jnp.add, acc, g))
+
+    def put(self, x):
+        """Move an activation/cotangent onto this stage's device —
+        the DCN/ICI transfer point (≡ p2p isend/irecv)."""
+        if self.device is None:
+            return x
+        return jax.device_put(x, self.device)
+
+
+def host_pipeline_train_step(stages: Sequence[HostPipelineStage],
+                             params_list: Sequence[Any],
+                             microbatches: Sequence[Any],
+                             schedule: str = "1f1b",
+                             return_stats: bool = False):
+    """Run one training step over `microbatches` with per-stage jitted
+    programs in 1F1B (or fill-drain "gpipe") order.
+
+    stages[-1].apply_fn must return a SCALAR loss (mean over its
+    microbatch).  Returns (mean_loss, [per-stage grad pytrees]).
+
+    ≡ forward_backward_pipelining_without_interleaving
+    (schedules/fwd_bwd_pipelining_without_interleaving.py): same
+    warmup/steady/drain structure, with device_put as the p2p layer.
+    """
+    n_stage = len(stages)
+    n_mb = len(microbatches)
+    if schedule not in ("1f1b", "gpipe"):
+        raise ValueError(f"unknown schedule {schedule!r}")
+    # commit each stage's params to its device once; every jitted call
+    # then runs where its inputs live
+    params_list = [st.put(p) for st, p in zip(stages, params_list)]
+
+    # per-stage FIFO of saved inputs (the only cross-program residual)
+    saved_x: List[List[Any]] = [[] for _ in range(n_stage)]
+    in_q: List[List[Any]] = [[] for _ in range(n_stage)]   # awaiting fwd
+    dy_q: List[List[Any]] = [[] for _ in range(n_stage)]   # awaiting bwd
+    in_q[0] = list(microbatches)
+    grads: List[Optional[Any]] = [None] * n_stage
+    losses: List[Any] = []
+    fwd_done = [0] * n_stage
+    bwd_done = [0] * n_stage
+    peaks = [0] * n_stage
+
+    # the 1F1B invariant, PER STAGE: stage i keeps at most
+    # n_stage - i saved inputs in flight (its warmup depth + 1); gpipe
+    # has no cap and holds all n_mb during fill
+    def cap(i):
+        return n_mb if schedule == "gpipe" else (n_stage - i)
+
+    def do_fwd(i):
+        st = stages[i]
+        x = st.put(in_q[i].pop(0))
+        saved_x[i].append(x)
+        peaks[i] = max(peaks[i], len(saved_x[i]))
+        fwd_done[i] += 1
+        if i < n_stage - 1:
+            in_q[i + 1].append(st._fwd(params_list[i], x))
+        # the last stage's fwd is fused into its loss_bwd
+
+    def do_bwd(i):
+        st = stages[i]
+        x = saved_x[i].pop(0)               # FIFO ≡ 1F1B backward order
+        if i == n_stage - 1:
+            loss, dparams, dx = st._loss_bwd(params_list[i], x)
+            losses.append(loss)
+        else:
+            dy = st.put(dy_q[i].pop(0))
+            dparams, dx = st._bwd(params_list[i], x, dy)
+        grads[i] = (dparams if grads[i] is None
+                    else st._accum(grads[i], dparams))
+        bwd_done[i] += 1
+        if i > 0:
+            dy_q[i - 1].append(dx)
+
+    # dependency-driven sweeps (async dispatch pipelines the devices):
+    # each round, every stage runs its ready backward (later stages
+    # first, so cotangents flow a full hop per round) and then its
+    # ready forward (earlier stages first) — gated by the in-flight cap.
+    # gpipe degenerates to fill-then-drain because backwards only
+    # become ready once forwards stop being capped (cap = n_mb).
+    while bwd_done[0] < n_mb:
+        progressed = False
+        for i in range(n_stage - 1, -1, -1):
+            bwd_ready = (len(saved_x[i]) > 0
+                         and (dy_q[i] if i < n_stage - 1
+                              else saved_x[i]))
+            if schedule == "gpipe" and fwd_done[0] < n_mb:
+                bwd_ready = False       # fill first
+            if bwd_ready:
+                do_bwd(i)
+                progressed = True
+        for i in range(n_stage):
+            if in_q[i] and len(saved_x[i]) < cap(i):
+                do_fwd(i)
+                progressed = True
+        if not progressed:
+            raise RuntimeError(
+                "host pipeline stalled — schedule invariant violated "
+                f"(fwd_done={fwd_done}, bwd_done={bwd_done})")
+
+    mean_loss = sum(jax.device_get(l) for l in losses) / n_mb
+    # grads are per-microbatch sums of per-mb means; normalize to the
+    # global-batch mean (each stage on its own device)
+    scale = 1.0 / n_mb
+    grads_out = [
+        jax.tree_util.tree_map(lambda g: g * scale, grads[i])
+        for i in range(n_stage)
+    ]
+    if return_stats:
+        return mean_loss, grads_out, {
+            "peak_in_flight": max(peaks),
+            "peak_in_flight_per_stage": peaks,
+        }
+    return mean_loss, grads_out

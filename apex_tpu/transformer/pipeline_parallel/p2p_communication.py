@@ -9,6 +9,12 @@ the negotiation protocol (168-383) disappears; "async" is XLA's default.
 The 8 public ops (recv_forward … send_forward_backward_recv_forward_backward,
 p2p_communication.py:385-690) reduce to forward/backward ring shifts:
 a send_forward IS everyone's recv_forward under SPMD.
+
+For DCN-spanning (multi-slice / multi-host) pipelines, where one
+compiled program cannot cover all stages, use the HOST-DRIVEN driver
+instead: `pipeline_parallel.host_driver` runs per-stage jitted
+programs in 1F1B order with `device_put` as the transfer layer — the
+full equivalent of the reference's send/recv-driven schedule engine.
 """
 
 from __future__ import annotations
