@@ -403,10 +403,50 @@ def _adam_1b_step_ms(on_tpu):
     return (time.perf_counter() - t0) / iters * 1e3
 
 
+def _run_isolated(metric):
+    """Re-run one metric in a fresh subprocess (`bench.py --only X`) and
+    return its value.  The ResNet number measures 2,305-2,319 img/s in a
+    clean process but 2,206-2,294 after the GPT/BERT metrics have
+    fragmented HBM in this one (docs/PERF.md round-5 note) — process
+    isolation recovers the clean-machine number the reference's
+    standalone main_amp.py harness would print.  Requires a runtime that
+    admits a second TPU client while the parent's is alive (the tunnel
+    backend here does; measured concurrent-process runs both produced
+    real-chip numbers) — on process-exclusive runtimes the child exits
+    nonzero and the caller falls back to the in-process measurement,
+    marked `resnet50_isolated: false` in the JSON."""
+    import os
+    import subprocess
+
+    out = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--only", metric],
+        capture_output=True, text=True, timeout=900, check=True)
+    line = out.stdout.strip().splitlines()[-1]
+    return json.loads(line)[metric]
+
+
+_ONLY = {
+    "resnet50_img_per_sec": lambda on_tpu: round(
+        _retry(_resnet50_img_per_sec, on_tpu), 1),
+}
+
+
 def main():
     from apex_tpu.models.gpt import GPTConfig
 
     on_tpu = jax.default_backend() not in ("cpu",)
+    if len(sys.argv) == 3 and sys.argv[1] == "--only":
+        metric = sys.argv[2]
+        if not on_tpu:
+            # a --only child exists to give a TPU metric a fresh
+            # process; landing on CPU here means backend acquisition
+            # fell back — hard-fail so the parent's fallback runs
+            # rather than recording a CPU number as the TPU metric
+            print(f"--only {metric}: backend is "
+                  f"{jax.default_backend()}, not TPU", file=sys.stderr)
+            sys.exit(3)
+        print(json.dumps({metric: _ONLY[metric](on_tpu)}))
+        return
     if on_tpu:
         # batch 12 + bf16 Adam state (round 4): the optimizer+cast tail
         # drops from 17 ms to ~5 ms and batch 12 amortizes fixed costs
@@ -455,8 +495,18 @@ def main():
     except Exception as e:
         result["bert_error"] = repr(e)[:120]
     try:
-        result["resnet50_img_per_sec"] = round(
-            _retry(_resnet50_img_per_sec, on_tpu), 1)
+        if on_tpu:
+            try:
+                result["resnet50_img_per_sec"] = _run_isolated(
+                    "resnet50_img_per_sec")
+                result["resnet50_isolated"] = True
+            except Exception:
+                result["resnet50_img_per_sec"] = _ONLY[
+                    "resnet50_img_per_sec"](on_tpu)
+                result["resnet50_isolated"] = False
+        else:
+            result["resnet50_img_per_sec"] = _ONLY[
+                "resnet50_img_per_sec"](on_tpu)
     except Exception as e:
         result["resnet50_error"] = repr(e)[:120]
     try:

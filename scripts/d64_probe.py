@@ -1,0 +1,115 @@
+"""Probe: does head_dim=64 cost HBM lane padding + bandwidth, and how
+much of the attention sublayer is layout glue (transposes around the
+flash kernel) vs the kernel itself?
+
+Three measurements on the real chip:
+  1. memory_analysis argument bytes for (b,h,s,64) vs (b,h,s,128)
+     bf16 arrays feeding the flash kernel — is the minor-64 array
+     lane-padded in HBM (2x bytes)?
+  2. Copy bandwidth: time jit(lambda x: x + 1) over both shapes.
+  3. The GPT attention sublayer glue: time (a) the full sublayer,
+     (b) flash kernel alone on pre-transposed operands, (c) the
+     qkv reshape/transpose + ctx transpose alone.
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+B, H, S, D = 12, 16, 1024, 64
+
+
+def timeit(f, *args, iters=20):
+    o = f(*args)
+    _ = np.asarray(jax.tree.leaves(o)[0].ravel()[0])
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        o = f(*args)
+    _ = np.asarray(jax.tree.leaves(o)[0].ravel()[0])
+    return (time.perf_counter() - t0) / iters * 1e3
+
+
+def main():
+    k = jax.random.PRNGKey(0)
+
+    # --- 1. memory analysis: lane padding of minor-64 ---
+    for d in (64, 128):
+        x = jnp.zeros((B, H, S, d), jnp.bfloat16)
+        c = jax.jit(lambda x: x * 2).lower(x).compile()
+        ma = c.memory_analysis()
+        logical = B * H * S * d * 2
+        print(f"d={d}: arg_bytes={ma.argument_size_in_bytes} "
+              f"logical={logical} ratio={ma.argument_size_in_bytes/logical:.2f}")
+
+    # --- 2. elementwise bandwidth over both shapes ---
+    x64 = jax.random.normal(k, (B, H, S, 64), jnp.bfloat16)
+    x128 = jax.random.normal(k, (B, H, S, 128), jnp.bfloat16)
+    f = jax.jit(lambda x: x + 1)
+    t64 = timeit(f, x64)
+    t128 = timeit(f, x128)
+    print(f"x+1: d=64 {t64:.3f} ms, d=128 {t128:.3f} ms "
+          f"(same time => 64 is padded)")
+
+    # --- 3. attention sublayer glue ---
+    from apex_tpu.ops.flash_attention import flash_attention
+
+    hdim = H * D
+    xs = jax.random.normal(k, (S, B, hdim), jnp.bfloat16)
+    wqkv = jax.random.normal(k, (hdim, 3 * hdim), jnp.bfloat16) * 0.02
+    wproj = jax.random.normal(k, (hdim, hdim), jnp.bfloat16) * 0.02
+
+    def sublayer(x, wqkv, wproj):
+        qkv = x @ wqkv
+        s, b, _ = qkv.shape
+        qkv = qkv.reshape(s, b, 3, H, D)
+        q, kk, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        q, kk, v = (t.transpose(1, 2, 0, 3) for t in (q, kk, v))
+        ctx = flash_attention(q, kk, v, causal=True,
+                              softmax_scale=D ** -0.5)
+        ctx = ctx.transpose(2, 0, 1, 3).reshape(s, b, -1)
+        return ctx @ wproj
+
+    def glue_only(x, wqkv):
+        qkv = x @ wqkv
+        s, b, _ = qkv.shape
+        qkv = qkv.reshape(s, b, 3, H, D)
+        q, kk, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        q, kk, v = (t.transpose(1, 2, 0, 3) for t in (q, kk, v))
+        # ctx stand-in: transpose q back (same relayout cost as ctx)
+        ctx = q.transpose(2, 0, 1, 3).reshape(s, b, -1)
+        return ctx
+
+    q = jax.random.normal(k, (B, H, S, D), jnp.bfloat16)
+    kv = jax.random.split(k, 2)
+    kq = jax.random.normal(kv[0], (B, H, S, D), jnp.bfloat16)
+    vv = jax.random.normal(kv[1], (B, H, S, D), jnp.bfloat16)
+
+    def grad_ms(fn, *args):
+        g = jax.jit(jax.grad(
+            lambda *a: fn(*a).astype(jnp.float32).mean(),
+            argnums=tuple(range(len(args)))))
+        return timeit(g, *args)
+
+    t_sub = grad_ms(sublayer, xs, wqkv, wproj)
+    t_kernel = grad_ms(
+        lambda q, kk, v: flash_attention(q, kk, v, causal=True,
+                                         softmax_scale=D ** -0.5),
+        q, kq, vv)
+    t_glue = grad_ms(glue_only, xs, wqkv)
+    # matmuls alone (qkv proj + out proj)
+    t_mm = grad_ms(lambda x, a, b: (x @ a)[..., :hdim] @ b,
+                   xs, wqkv, wproj)
+    print(f"sublayer fwd+bwd {t_sub:.2f} ms | kernel {t_kernel:.2f} | "
+          f"glue(qkv proj+transposes) {t_glue:.2f} | proj-matmuls {t_mm:.2f}")
+    print(f"x24 layers: sublayer {24*t_sub:.1f} ms, "
+          f"non-kernel non-matmul residue "
+          f"{24*(t_sub - t_kernel - t_mm):.1f} ms")
+
+
+if __name__ == "__main__":
+    main()
