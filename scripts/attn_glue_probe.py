@@ -1,0 +1,158 @@
+"""Scan-slope (dispatch-amortized) decomposition of the GPT attention
+sublayer at the 350M bench shape: how much of the 4.75 ms/layer is the
+flash kernel, the two projections, and layout glue (qkv split +
+(b,s,h,d)<->(b,h,s,d) transposes)?  Decides whether killing the
+transposes can close the 48.9k -> 50k tok/s gap.
+
+MEASURED CONCLUSION (round 5, real chip): no.  einsum variants whose
+projection output is already kernel-layout (b,h,s,d) — one packed
+'bsh,hknd->kbnsd' or three separate — time WITHIN NOISE of the
+split+transpose sublayer (4.51-4.85 vs 4.53 ms/layer), and the
+standalone split+transpose loop measures at the slope-timing noise
+floor.  XLA already schedules the relayouts at negligible marginal
+cost; the attention plateau is the d=64 score-contraction shape bound
+(docs/PERF.md anatomy), not layout glue.  Kept as the record of the
+negative result."""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+PEAK = 197e12
+B, H, S, Dh = 12, 16, 1024, 64
+HID = H * Dh
+
+
+def _scan_time(fn, args, iters=20, reps=3):
+    def make(length):
+        def many(*a):
+            def body(carry, _):
+                out = fn(*((a[0] + carry.astype(a[0].dtype),) + a[1:]))
+                return sum(jnp.sum(l.astype(jnp.float32))
+                           for l in jax.tree.leaves(out)) * 1e-30, None
+            c, _ = lax.scan(body, jnp.zeros((), jnp.float32), None,
+                            length=length)
+            return c
+        return jax.jit(many)
+
+    def total(f):
+        _ = np.asarray(f(*args))
+        best = np.inf
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            _ = np.asarray(f(*args))
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    lo, hi = max(1, iters // 5), iters
+    return (total(make(hi)) - total(make(lo))) / (hi - lo)
+
+
+def fb(fn):
+    def run(*args):
+        out, vjp = jax.vjp(fn, *args)
+        return (out,) + vjp(out)
+    return run
+
+
+def main():
+    key = jax.random.PRNGKey(0)
+    from apex_tpu.ops.flash_attention import flash_attention
+
+    x = jax.random.normal(key, (B, S, HID), jnp.bfloat16)
+    wqkv = jax.random.normal(key, (HID, 3 * HID), jnp.bfloat16) * 0.02
+    wo = jax.random.normal(key, (HID, HID), jnp.bfloat16) * 0.02
+    q = jax.random.normal(key, (B, H, S, Dh), jnp.bfloat16)
+    k = jax.random.normal(key, (B, H, S, Dh), jnp.bfloat16) * 0.5
+    v = jax.random.normal(key, (B, H, S, Dh), jnp.bfloat16) * 0.5
+
+    def attn(x, wqkv, wo):
+        qkv = x @ wqkv
+        qq, kk, vv = jnp.split(qkv, 3, axis=-1)
+
+        def heads_of(t):
+            return t.reshape(B, S, H, Dh).transpose(0, 2, 1, 3)
+
+        o = flash_attention(heads_of(qq), heads_of(kk), heads_of(vv),
+                            causal=True)
+        o = o.transpose(0, 2, 1, 3).reshape(B, S, HID)
+        return o @ wo
+
+    def kernel_only(q, k, v):
+        return flash_attention(q, k, v, causal=True)
+
+    def projs_only(x, wqkv, wo):
+        qkv = x @ wqkv
+        # consume qkv without the head transposes; same matmul shapes
+        o = qkv[..., :HID] + qkv[..., HID:2 * HID] + qkv[..., 2 * HID:]
+        return o @ wo
+
+    def glue_only(x3):
+        # the pure layout work: split + head transposes + merge back
+        qq, kk, vv = jnp.split(x3, 3, axis=-1)
+
+        def heads_of(t):
+            return t.reshape(B, S, H, Dh).transpose(0, 2, 1, 3)
+
+        a, b, c = heads_of(qq), heads_of(kk), heads_of(vv)
+        o = (a + b + c).transpose(0, 2, 1, 3).reshape(B, S, HID)
+        return o
+
+    x3 = jax.random.normal(key, (B, S, 3 * HID), jnp.bfloat16)
+
+    def attn_einsum(x, wqkv, wo):
+        # projection output ALREADY in kernel layout: XLA folds the
+        # (b,s,h,d)->(b,h,s,d) relayout into the dot epilogue (or a
+        # cheaper fused copy) instead of separate transpose passes
+        w4 = wqkv.reshape(HID, 3, H, Dh)
+        qkv = jnp.einsum("bsh,hknd->kbnsd", x, w4,
+                         preferred_element_type=jnp.float32
+                         ).astype(x.dtype)
+        o = flash_attention(qkv[0], qkv[1], qkv[2], causal=True)
+        w2 = wo.reshape(H, Dh, HID)
+        return jnp.einsum("bnsd,ndh->bsh", o, w2,
+                          preferred_element_type=jnp.float32
+                          ).astype(x.dtype)
+
+    def attn_einsum3(x, wqkv, wo):
+        w4 = wqkv.reshape(HID, 3, H, Dh)
+        q = jnp.einsum("bsh,hnd->bnsd", x, w4[:, 0],
+                       preferred_element_type=jnp.float32).astype(x.dtype)
+        k = jnp.einsum("bsh,hnd->bnsd", x, w4[:, 1],
+                       preferred_element_type=jnp.float32).astype(x.dtype)
+        v = jnp.einsum("bsh,hnd->bnsd", x, w4[:, 2],
+                       preferred_element_type=jnp.float32).astype(x.dtype)
+        o = flash_attention(q, k, v, causal=True)
+        w2 = wo.reshape(H, Dh, HID)
+        return jnp.einsum("bnsd,ndh->bsh", o, w2,
+                          preferred_element_type=jnp.float32
+                          ).astype(x.dtype)
+
+    t_attn = _scan_time(fb(attn), (x, wqkv, wo))
+    t_e1 = _scan_time(fb(attn_einsum), (x, wqkv, wo))
+    t_e3 = _scan_time(fb(attn_einsum3), (x, wqkv, wo))
+    t_kern = _scan_time(fb(kernel_only), (q, k, v))
+    t_proj = _scan_time(fb(projs_only), (x, wqkv, wo))
+    t_glue = _scan_time(fb(glue_only), (x3,))
+
+    fl_proj = 2 * B * S * HID * 4 * HID * 3
+    print(f"sublayer  {t_attn*1e3:7.3f} ms/layer  x24 {24*t_attn*1e3:6.1f} ms")
+    print(f"einsum-1  {t_e1*1e3:7.3f} ms/layer  x24 {24*t_e1*1e3:6.1f} ms")
+    print(f"einsum-3  {t_e3*1e3:7.3f} ms/layer  x24 {24*t_e3*1e3:6.1f} ms")
+    print(f"kernel    {t_kern*1e3:7.3f} ms/layer")
+    print(f"projs     {t_proj*1e3:7.3f} ms/layer "
+          f"({fl_proj/t_proj/1e12:.0f} TF/s {100*fl_proj/t_proj/PEAK:.0f}%pk)")
+    print(f"glue-only {t_glue*1e3:7.3f} ms/layer (split+transposes std-alone)")
+    resid = t_attn - t_kern - t_proj
+    print(f"sublayer - kernel - projs = {resid*1e3:7.3f} ms/layer "
+          f"-> x24 = {24*resid*1e3:.1f} ms of removable glue?")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,115 @@
+"""Can a Pallas kernel read per-head (bq, d) blocks STRIDED from the
+packed qkv activation ((S, B, 3, H, D) / (B, S, 3, H, D)) at useful
+bandwidth, or does the 128-byte row granularity kill it?  Decides
+whether flash attention can consume projection-layout qkv directly
+(zero transpose glue) instead of requiring (B, H, S, D) copies.
+
+MEASURED NOTES (round 5): single-head 5D blocks are rejected by the
+Pallas TPU lowering (second-minor block dim must divide 8 or equal the
+array dim), so packed reads must take head PAIRS (1, S, 128).  The
+strided head-pair gather does run at usable bandwidth, but the sibling
+attn_glue_probe.py showed the transposes this would eliminate cost ~0
+in context, so the kernel keeps its (B, H, S, D) contract.  Kept as
+the record of the negative result."""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.experimental import pallas as pl
+
+B, H, S, D = 12, 16, 1024, 64
+
+
+def _scan_time(fn, args, iters=100, reps=5):
+    def make(length):
+        def many(*a):
+            def body(carry, _):
+                out = fn(a[0] + carry.astype(a[0].dtype), *a[1:])
+                return carry + jnp.sum(out[0, 0].astype(jnp.float32)) * 1e-30, None
+            c, _ = lax.scan(body, jnp.zeros((), jnp.float32), None,
+                            length=length)
+            return c
+        return jax.jit(many)
+
+    def total(f):
+        _ = np.asarray(f(*args))
+        best = np.inf
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            _ = np.asarray(f(*args))
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    lo, hi = max(1, iters // 5), iters
+    return (total(make(hi)) - total(make(lo))) / (hi - lo)
+
+
+def _copy_kernel(src_ref, dst_ref):
+    dst_ref[...] = src_ref[...]
+
+
+def main():
+    key = jax.random.PRNGKey(0)
+    # packed qkv activation, model layout (B, S, 3, H, D)
+    qkv = jax.random.normal(key, (B, S, 3, H, D), jnp.bfloat16)
+
+    # 1. contiguous baseline: copy already-transposed (B,H,S,D) q
+    qt = jax.random.normal(key, (B, H, S, D), jnp.bfloat16)
+
+    def copy_contig(q):
+        return pl.pallas_call(
+            _copy_kernel,
+            grid=(B * H,),
+            in_specs=[pl.BlockSpec((1, 1, S, D),
+                                   lambda i: (i // H, i % H, 0, 0))],
+            out_specs=pl.BlockSpec((1, 1, S, D),
+                                   lambda i: (i // H, i % H, 0, 0)),
+            out_shape=jax.ShapeDtypeStruct((B, H, S, D), q.dtype),
+        )(q)
+
+    # 2. strided: gather q HEAD-PAIR planes straight out of packed qkv
+    # flattened to (B, S, 3*H*D); a (1, S, 128)-lane block = heads
+    # (2j, 2j+1) of q with 256-byte rows strided 6 KB apart
+    def copy_strided(qkv):
+        flat = qkv.reshape(B, S, 3 * H * D)
+
+        def kern(src_ref, dst_ref):
+            dst_ref[...] = src_ref[...].reshape(1, 1, S, 2 * D)
+        return pl.pallas_call(
+            kern,
+            grid=(B * H // 2,),
+            in_specs=[pl.BlockSpec((1, S, 2 * D),
+                                   lambda i: (i // (H // 2), 0,
+                                              i % (H // 2)))],
+            out_specs=pl.BlockSpec((1, 1, S, 2 * D),
+                                   lambda i: (i // (H // 2),
+                                              i % (H // 2), 0, 0)),
+            out_shape=jax.ShapeDtypeStruct((B, H // 2, S, 2 * D),
+                                           qkv.dtype),
+        )(flat)
+
+    # 3. XLA transpose of the same logical op (split+transpose)
+    def xla_transpose(qkv):
+        q = qkv[:, :, 0]                      # (B, S, H, D)
+        return q.transpose(0, 2, 1, 3)        # (B, H, S, D)
+
+    t1 = _scan_time(copy_contig, (qt,))
+    t2 = _scan_time(copy_strided, (qkv,))
+    t3 = _scan_time(jax.jit(xla_transpose), (qkv,))
+    nbytes = B * H * S * D * 2
+    for name, t in (("contig pallas copy", t1), ("strided pallas gather", t2),
+                    ("xla slice+transpose", t3)):
+        print(f"{name:22s} {t*1e3:7.3f} ms  "
+              f"{2*nbytes/t/1e9:6.0f} GB/s (r+w)")
+
+
+if __name__ == "__main__":
+    main()
