@@ -1,0 +1,90 @@
+"""Does widening the flat-buffer 2D view lift the bf16 Adam kernel's
+HBM bandwidth?  docs/PERF.md: bf16-state Adam runs ~500 GB/s vs the
+fp32 kernel's 721 GB/s because a (512, 128)-bf16 block row is a
+256-byte burst (fp32 rows are 512 B).  A (rows, 256) or (rows, 512)
+bf16 view doubles/quadruples the row burst with the same elementwise
+kernel.  Measures the full Adam update for lane widths 128/256/512 and
+block rows 256/512/1024.
+
+MEASURED CONCLUSION (round 5, real chip, 0.5 Gi elements): widening
+lanes makes it WORSE — 128 lanes 20.8-23.7 ms, 256 lanes ~49 ms, 512
+lanes ~47 ms (Mosaic handles >128-lane tiles as multi-register values
+and the emitted code slows 2.3x); rows=512 is the knee.  So the bf16
+Adam pass is VPU-bound as docs/PERF.md says, not DMA-burst-bound, and
+the (512, 128) flat view stands.  Kept as the record of the negative
+result."""
+import os
+import sys
+import time
+import functools
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+from apex_tpu.ops.optimizer_kernels import _adam_kernel, _adam_fold_scalars
+
+N = 536_870_912  # 0.5 Gi elements, divisible by 1024*512
+
+
+def adam_lanes(p, m, v, g, scalars, lanes, rows):
+    shape = (N // lanes, lanes)
+    p2, m2, v2, g2 = (a.reshape(shape) for a in (p, m, v, g))
+    grid = shape[0] // rows
+    spec = pl.BlockSpec((rows, lanes), lambda i: (i, 0))
+    sspec = pl.BlockSpec((9, 1), lambda i: (0, 0))
+    kernel = functools.partial(_adam_kernel, eps=1e-8,
+                               weight_decay=0.0, adam_w_mode=True)
+    pn, mn, vn = pl.pallas_call(
+        kernel,
+        grid=(grid,),
+        in_specs=[spec, spec, spec, spec, sspec],
+        out_specs=[spec, spec, spec],
+        out_shape=[jax.ShapeDtypeStruct(shape, x.dtype)
+                   for x in (p2, m2, v2)],
+        input_output_aliases={0: 0, 1: 1, 2: 2},
+    )(p2, m2, v2, g2, scalars)
+    return pn.reshape(-1), mn.reshape(-1), vn.reshape(-1)
+
+
+def main():
+    dt = jnp.bfloat16
+    p = jnp.zeros((N,), dt)
+    m = jnp.zeros((N,), dt)
+    v = jnp.zeros((N,), dt)
+    g = jnp.full((N,), 1e-3, dt)
+    scalars = np.asarray(_adam_fold_scalars(1e-3, 10, 0.9, 0.999, True,
+                                            1.0, False))
+    scalars = jnp.asarray(scalars)
+    nbytes = N * 2 * 7  # r/w p,m,v + r g
+
+    for lanes in (128, 256, 512):
+        for rows in (256, 512, 1024):
+            step = jax.jit(functools.partial(adam_lanes, lanes=lanes,
+                                             rows=rows),
+                           donate_argnums=(0, 1, 2))
+            try:
+                pp, mm, vv = step(p, m, v, g, scalars)
+                np.asarray(pp[:1])
+                t0 = time.perf_counter()
+                iters = 10
+                for _ in range(iters):
+                    pp, mm, vv = step(pp, mm, vv, g, scalars)
+                np.asarray(pp[:1])
+                dtms = (time.perf_counter() - t0) / iters * 1e3
+                print(f"lanes={lanes:4d} rows={rows:5d}: {dtms:6.2f} ms "
+                      f"{nbytes/dtms*1e3/1e9:6.0f} GB/s")
+                p, m, v = pp, mm, vv
+            except Exception as e:
+                print(f"lanes={lanes:4d} rows={rows:5d}: FAILED "
+                      f"{repr(e)[:90]}")
+                p = jnp.zeros((N,), dt)
+                m = jnp.zeros((N,), dt)
+                v = jnp.zeros((N,), dt)
+
+
+if __name__ == "__main__":
+    main()
