@@ -1,0 +1,48 @@
+"""Shared example bootstrap (import before jax in an example's header).
+
+Importing this module puts the repo root on sys.path (the examples run
+as plain scripts, unpip-installed) and provides the one flag that must
+act BEFORE the first JAX backend use:
+
+    --force-cpu-devices N   run on N emulated CPU devices
+
+A session may pin a TPU plugin that IGNORES the JAX_PLATFORMS env var,
+so the only reliable override is jax.config before backend init — the
+same bootstrap tests/conftest.py uses.  The flag is left in sys.argv so
+the example's argparse can document and record it.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _flag_value():
+    for i, a in enumerate(sys.argv):
+        if a == "--force-cpu-devices":
+            if i + 1 >= len(sys.argv):
+                sys.exit("--force-cpu-devices requires an integer value")
+            return sys.argv[i + 1]
+        if a.startswith("--force-cpu-devices="):
+            return a.split("=", 1)[1]
+    return None
+
+
+def force_cpu_devices_from_argv():
+    """Consume --force-cpu-devices N (or =N) from sys.argv; no-op if
+    absent or 0."""
+    raw = _flag_value()
+    if raw is None:
+        return
+    try:
+        n = int(raw)
+    except ValueError:
+        sys.exit(f"--force-cpu-devices requires an integer value, "
+                 f"got {raw!r}")
+    if n <= 0:
+        return
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", n)

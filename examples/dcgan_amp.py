@@ -25,6 +25,10 @@ _sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)
 import argparse
 import time
 
+import _bootstrap
+
+_bootstrap.force_cpu_devices_from_argv()
+
 import jax
 import jax.numpy as jnp
 from jax import lax
@@ -197,6 +201,9 @@ def main():
     ap.add_argument("--lr", type=float, default=2e-4)
     ap.add_argument("--beta1", type=float, default=0.5)
     ap.add_argument("--opt-level", default="O1")
+    ap.add_argument("--force-cpu-devices", type=int, default=0,
+                    help="run on N emulated CPU devices (consumed "
+                         "before backend init, above)")
     args = ap.parse_args()
 
     G = Generator(args.image_size, args.nz)

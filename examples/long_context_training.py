@@ -30,14 +30,9 @@ _sys.path.insert(0, _os.path.dirname(_os.path.dirname(
 import argparse
 import time
 
-# --force-cpu-devices N must act BEFORE the first backend use (the
-# session may pin a TPU plugin that ignores JAX_PLATFORMS env) — same
-# bootstrap as tests/conftest.py
-if "--force-cpu-devices" in _sys.argv:
-    _n = int(_sys.argv[_sys.argv.index("--force-cpu-devices") + 1])
-    import jax
-    jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", _n)
+import _bootstrap
+
+_bootstrap.force_cpu_devices_from_argv()
 
 import jax
 import jax.numpy as jnp

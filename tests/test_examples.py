@@ -25,8 +25,11 @@ def _run(script, *args, timeout=600):
 
 @pytest.mark.parametrize("opt_level", ["O1", "O2"])
 def test_dcgan_runs(opt_level):
+    # --force-cpu-devices: JAX_PLATFORMS=cpu in env is IGNORED when a
+    # TPU plugin is pinned (see conftest), so force through jax.config
     r = _run("dcgan_amp.py", "--batch-size", "8", "--image-size", "32",
-             "--iters", "6", "--opt-level", opt_level)
+             "--iters", "6", "--opt-level", opt_level,
+             "--force-cpu-devices", "1")
     assert r.returncode == 0, r.stderr[-2000:]
     assert "Loss_D" in r.stdout and "nan" not in r.stdout.lower()
 
