@@ -106,6 +106,14 @@ def host_pipeline_train_step(stages: Sequence[HostPipelineStage],
     n_mb = len(microbatches)
     if schedule not in ("1f1b", "gpipe"):
         raise ValueError(f"unknown schedule {schedule!r}")
+    if n_stage == 0 or n_mb == 0:
+        raise ValueError(
+            f"need at least one stage and one microbatch, got "
+            f"{n_stage} stage(s), {n_mb} microbatch(es)")
+    if len(params_list) != n_stage:
+        raise ValueError(
+            f"params_list has {len(params_list)} entries for "
+            f"{n_stage} stages")
     # commit each stage's params to its device once; every jitted call
     # then runs where its inputs live
     params_list = [st.put(p) for st, p in zip(stages, params_list)]

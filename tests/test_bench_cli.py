@@ -1,0 +1,63 @@
+"""The bench.py --only / _run_isolated harness (round 5): the ResNet
+metric is measured in a fresh subprocess so HBM fragmentation from the
+GPT/BERT metrics cannot depress it.  These tests pin the CLI contract
+without touching a device: JSON plumbing, retry placement, and the
+fallback semantics main() relies on."""
+
+import os
+import sys
+import types
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import bench
+
+
+def test_only_registry_retries_and_rounds(monkeypatch):
+    """_ONLY wraps the measurement in _retry (a transient tunnel flake
+    must not discard isolation — review r5) and rounds to 0.1."""
+    calls = []
+
+    def fake_resnet(on_tpu):
+        calls.append(on_tpu)
+        if len(calls) == 1:
+            raise RuntimeError("remote_compile: response body closed")
+        return 2345.6789
+
+    monkeypatch.setattr(bench, "_resnet50_img_per_sec", fake_resnet)
+    monkeypatch.setattr(bench.time, "sleep", lambda *_: None)
+    out = bench._ONLY["resnet50_img_per_sec"](True)
+    assert out == 2345.7
+    assert calls == [True, True]  # transient error retried
+
+
+def test_run_isolated_parses_last_json_line(monkeypatch):
+    def fake_run(cmd, **kw):
+        assert cmd[1].endswith("bench.py")
+        assert cmd[2:] == ["--only", "resnet50_img_per_sec"]
+        assert kw.get("check") is True
+        return types.SimpleNamespace(
+            stdout="WARNING: noisy plugin line\n"
+                   '{"resnet50_img_per_sec": 2310.4}\n',
+            returncode=0)
+
+    # _run_isolated imports subprocess function-locally; patch the module
+    import subprocess as sp
+    monkeypatch.setattr(sp, "run", fake_run)
+    assert bench._run_isolated("resnet50_img_per_sec") == 2310.4
+
+
+def test_run_isolated_propagates_child_failure(monkeypatch):
+    """A child that exits nonzero (e.g. --only on a CPU-fallback
+    backend exits 3) must raise so main() records
+    resnet50_isolated=false and measures in-process instead."""
+    import subprocess as sp
+
+    def fake_run(cmd, **kw):
+        raise sp.CalledProcessError(3, cmd, stderr="backend is cpu")
+
+    monkeypatch.setattr(sp, "run", fake_run)
+    with pytest.raises(sp.CalledProcessError):
+        bench._run_isolated("resnet50_img_per_sec")

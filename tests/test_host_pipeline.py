@@ -109,3 +109,17 @@ def test_host_pipeline_in_flight_bound():
                                              schedule="gpipe",
                                              return_stats=True)
     assert stats_g["peak_in_flight"] == len(mbs), stats_g
+
+
+def test_host_pipeline_rejects_empty():
+    """Zero microbatches/stages raise a clear ValueError rather than a
+    ZeroDivisionError in loss averaging (review r5 note)."""
+    fns, params = _mk_stage_fns(2)
+    stages = [HostPipelineStage(fns[i]) for i in range(2)]
+    with pytest.raises(ValueError, match="microbatch"):
+        host_pipeline_train_step(stages, params, [])
+    with pytest.raises(ValueError, match="stage"):
+        host_pipeline_train_step([], [], [jnp.ones((2, 16))])
+    with pytest.raises(ValueError, match="params_list"):
+        host_pipeline_train_step(stages, params[:1],
+                                 [jnp.ones((2, 16))])
