@@ -1,0 +1,54 @@
+"""Runtime version shims.
+
+The framework targets current jax — `jax.shard_map` at the top level
+with the `check_vma` kwarg.  Older runtimes (jax ≤ 0.4.x, e.g. a
+CPU-only CI image) still ship shard_map under `jax.experimental` with
+the kwarg named `check_rep`.  install() bridges that delta once, at
+import time, so every `from jax import shard_map` call site runs
+unchanged on both; it is a no-op on current jax.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def install():
+    from jax import lax
+
+    try:
+        from jax.experimental.pallas import tpu as pltpu
+        if (not hasattr(pltpu, "CompilerParams")
+                and hasattr(pltpu, "TPUCompilerParams")):
+            # renamed upstream: TPUCompilerParams (≤ 0.4.x) →
+            # CompilerParams; same kwargs (dimension_semantics etc.)
+            pltpu.CompilerParams = pltpu.TPUCompilerParams
+    except ImportError:  # pallas not available at all — kernels will
+        pass             # take their jnp fallback paths anyway
+
+    if not hasattr(lax, "axis_size"):
+        from jax._src import core as _core
+
+        def axis_size(axis_name):
+            frame = _core.axis_frame(axis_name)
+            if isinstance(frame, int):  # 0.4.x returns the size directly
+                return frame
+            return frame.size  # raise HERE if neither shape fits,
+            # not as a confusing type error at the caller
+
+        lax.axis_size = axis_size
+
+    if hasattr(jax, "shard_map"):
+        return
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    def shard_map(f, mesh=None, in_specs=None, out_specs=None,
+                  check_vma=True, **kw):
+        kw.setdefault("check_rep", check_vma)
+        return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, **kw)
+
+    jax.shard_map = shard_map
+
+
+install()

@@ -89,6 +89,11 @@ class GPTConfig:
     #     (qkv, attn_ctx, attn_out, ffn1, ffn_out — see _block); the
     #     memory/recompute dial between full remat and "dots"
     remat_policy: Any = None
+    # Vocab-parallel cross-entropy backward strategy: None = auto (the
+    # fused custom_vjp when logits are sub-fp32, saving compute-dtype
+    # residuals instead of the fp32 (S, B, V) upcast — cross_entropy.py
+    # module docstring); True/False force it for A/B sweeps.
+    fused_xent: Any = None
     axis_name: str = TP_AXIS
 
     @property
@@ -194,12 +199,10 @@ class GPT:
         qkv = _cn(qkv, "qkv")
         s, b, _ = qkv.shape
         nh_local = qkv.shape[-1] // (3 * c.head_dim)
-        qkv = qkv.reshape(s, b, 3, nh_local, c.head_dim)
-        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
-        # (b, nh, s, hd)
-        q = q.transpose(1, 2, 0, 3)
-        k = k.transpose(1, 2, 0, 3)
-        v = v.transpose(1, 2, 0, 3)
+        # one transpose of the PACKED tensor instead of three strided
+        # slice+transpose copies (ops/fused_dense.qkv_split_heads)
+        from apex_tpu.ops.fused_dense import qkv_split_heads
+        q, k, v = qkv_split_heads(qkv, nh_local, c.head_dim)
         if c.use_flash_attention:
             from apex_tpu.ops.flash_attention import flash_attention
             rate = c.dropout if key is not None else 0.0
@@ -311,7 +314,8 @@ class GPT:
         h = self.apply(params, tokens, key)
         logits = self.logits_local(params, h)  # (S,B,V/tp)
         loss = vocab_parallel_cross_entropy(
-            logits, labels.T, axis_name=self.c.axis_name)
+            logits, labels.T, axis_name=self.c.axis_name,
+            fused=self.c.fused_xent)
         return jnp.mean(loss)
 
 
@@ -422,7 +426,8 @@ class GPTPipelined(GPT):
             h_f = self._ln_final(params, h_mb)
             logits = self.logits_local(params, h_f)  # (S, mb, V/tp)
             return jnp.mean(vocab_parallel_cross_entropy(
-                logits, labels_mb, axis_name=c.axis_name))
+                logits, labels_mb, axis_name=c.axis_name,
+                fused=c.fused_xent))
 
         lbl = labels.reshape(m, mb, S).transpose(0, 2, 1)  # (m, S, mb)
         # head + loss run on the LAST STAGE inside the clocked scan and

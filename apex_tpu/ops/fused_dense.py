@@ -167,6 +167,26 @@ def linear_gelu_linear(x, w1, b1, w2, b2,
     return linear_bias(h, w2, b2, None, use_pallas_override)
 
 
+def qkv_split_heads(qkv, num_heads, head_dim):
+    """Packed-QKV head split: (S, B, 3·nh·d) → three (B, nh, S, d).
+
+    The QKV projection is already ONE GEMM (a single (H, 3H)
+    ColumnParallelLinear ≡ the reference's fused QKV,
+    standalone_transformer_lm.py attention).  What the round-6 per-GEMM
+    roofline flagged was the glue AFTER it: slicing q/k/v out of the
+    middle of the packed reshape and transposing each slice separately
+    costs three strided (S, B, nh, d) copies.  This helper transposes
+    the PACKED tensor once — (3, B, nh, S, d), one fused relayout whose
+    minor dim stays the lane-aligned head_dim — and hands out q/k/v as
+    leading-dim views (no further copy).  Gradient is the mirrored
+    single transpose (AD of transpose+concat).
+    """
+    s, b = qkv.shape[:2]
+    qkv = qkv.reshape(s, b, 3, num_heads, head_dim)
+    qkv = qkv.transpose(2, 1, 3, 0, 4)  # (3, B, nh, S, d)
+    return qkv[0], qkv[1], qkv[2]
+
+
 def wgrad_accum(main_grad, x, g):
     """main_grad += x^T @ g with fp32 accumulation.
 

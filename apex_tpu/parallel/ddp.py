@@ -122,7 +122,9 @@ def make_train_step(loss_fn: Callable, optimizer, mesh, *,
                     amp_state: Optional[amp_lib.AmpState] = None,
                     axis_name: str = DP_AXIS, donate: bool = True,
                     batch_spec=None, has_aux: bool = False,
-                    with_state: bool = False):
+                    with_state: bool = False,
+                    num_microbatches: int = 1,
+                    main_grad_dtype=None):
     """Build the fused data-parallel train step.
 
     `loss_fn(params, batch) -> loss` (or `(loss, aux)` with has_aux;
@@ -134,6 +136,18 @@ def make_train_step(loss_fn: Callable, optimizer, mesh, *,
     (opt_state, scaler_state[, model_state], loss[, aux])`, jitted over
     `mesh` with batch sharded on dp.
 
+    num_microbatches splits each shard's batch into that many
+    microbatches along the leading axis and accumulates their grads
+    inside the one jitted program (grad sync still happens ONCE, after
+    accumulation — no_sync semantics).  main_grad_dtype picks the
+    accumulator dtype: None accumulates in each param's own dtype (bf16
+    params → bf16 adds), float32 is the Apex main-grad guarantee — the
+    microbatch cotangents land in a persistent fp32 buffer regardless
+    of param/compute dtype (≡ wgrad_gemm_accum_fp32 into `.main_grad`,
+    reference transformer/tensor_parallel/layers.py:415-428).  The fp32
+    grads flow to the grad pmean and the fused optimizer as-is (the
+    flat kernels take any float grad dtype).
+
     ≡ the reference hot loop: DDP.forward → amp.scale_loss → backward
     hooks/allreduce → FusedAdam.step (SURVEY §3.2-3.3), collapsed into
     one compiled program.
@@ -142,6 +156,9 @@ def make_train_step(loss_fn: Callable, optimizer, mesh, *,
 
     policy = amp_state.policy if amp_state is not None else None
     dynamic = amp_state.dynamic if amp_state is not None else False
+    if num_microbatches < 1:
+        raise ValueError(f"num_microbatches must be >= 1, got "
+                         f"{num_microbatches}")
 
     def local_step(opt_state, scaler_state, model_state, batch):
         params = F.unflatten(opt_state.params, optimizer.spec)
@@ -155,9 +172,9 @@ def make_train_step(loss_fn: Callable, optimizer, mesh, *,
                 params = policy.cast_to_compute(params)
                 batch = policy.cast_to_compute(batch)
 
-        def scaled_loss_fn(p, b):
+        def scaled_loss_fn(p, mstate, b):
             if with_state:
-                loss, new_mstate = loss_fn(p, model_state, b)
+                loss, new_mstate = loss_fn(p, mstate, b)
                 aux = new_mstate
             else:
                 out = loss_fn(p, b)
@@ -167,8 +184,55 @@ def make_train_step(loss_fn: Callable, optimizer, mesh, *,
                 else loss
             return scaled, (aux, loss)
 
-        grads, (aux, loss) = jax.grad(scaled_loss_fn, has_aux=True)(
-            params, batch)
+        if num_microbatches == 1:
+            # nothing to accumulate: keep the single-shot path (and the
+            # bare aux return shape); main_grad_dtype only picks the
+            # dtype the grads leave backward in
+            grads, (aux, loss) = jax.grad(scaled_loss_fn, has_aux=True)(
+                params, model_state, batch)
+            if main_grad_dtype is not None:
+                grads = jax.tree_util.tree_map(
+                    lambda g: g.astype(main_grad_dtype), grads)
+        else:
+            m = num_microbatches
+
+            def split(x):
+                if x.shape[0] % m:
+                    raise ValueError(
+                        f"local batch dim {x.shape[0]} not divisible by "
+                        f"num_microbatches={m}")
+                return x.reshape((m, x.shape[0] // m) + x.shape[1:])
+
+            mbs = jax.tree_util.tree_map(split, batch)
+            acc0 = jax.tree_util.tree_map(
+                lambda p: jnp.zeros(
+                    p.shape, main_grad_dtype or p.dtype), params)
+
+            stack_aux = has_aux and not with_state
+
+            def body(carry, mb):
+                g_acc, mstate_c, loss_acc = carry
+                g, (aux_mb, loss_mb) = jax.grad(
+                    scaled_loss_fn, has_aux=True)(params, mstate_c, mb)
+                g_acc = jax.tree_util.tree_map(
+                    lambda a, gg: a + gg.astype(a.dtype), g_acc, g)
+                mstate_n = aux_mb if with_state else mstate_c
+                # stack per-microbatch auxes only when the caller gets
+                # them: a stacked copy of large model state as unused
+                # scan ys would cost m x its memory
+                return (g_acc, mstate_n,
+                        loss_acc + loss_mb.astype(jnp.float32)), (
+                            aux_mb if stack_aux else None)
+
+            (g_acc, mstate_f, loss_sum), auxs = jax.lax.scan(
+                body, (acc0, model_state, jnp.zeros((), jnp.float32)),
+                mbs)
+            grads = jax.tree_util.tree_map(lambda g: g / m, g_acc)
+            loss = loss_sum / m
+            # with_state: the threaded final state; has_aux: the stacked
+            # per-microbatch auxes (leading dim m)
+            aux = mstate_f if with_state else (
+                auxs if has_aux else None)
         grads = sync_gradients(grads, axis_name, average=True)
 
         if scaler_state is not None:

@@ -212,7 +212,8 @@ def _ring_shift(x, axis_name, delta):
 
 def forward_backward_no_pipelining(forward_step_func, batch, model_params, *,
                                    num_microbatches: int,
-                                   grad_fn: Optional[Callable] = None):
+                                   grad_fn: Optional[Callable] = None,
+                                   main_grad_dtype=None):
     """≡ fwd_bwd_no_pipelining.py:23-120: loop microbatches, average loss
     and accumulate grads (no_sync semantics are implicit — grads sync
     when the caller psums them once after this returns).
@@ -220,15 +221,48 @@ def forward_backward_no_pipelining(forward_step_func, batch, model_params, *,
     forward_step_func(params, microbatch) -> scalar loss.
     batch: pytree with leading dim num_microbatches.
     Returns (mean_loss, grads) via value_and_grad.
-    """
-    def total_loss(p):
-        acc, _ = lax.scan(
-            lambda a, mb: (a + forward_step_func(p, mb), None),
-            jnp.zeros((), jnp.float32), batch)
-        return acc / num_microbatches
 
-    loss, grads = jax.value_and_grad(total_loss)(model_params)
-    return loss, grads
+    main_grad_dtype: None keeps the historical path — AD through the
+    microbatch scan, whose cotangent carry (and therefore the
+    accumulator) lives in each param's OWN dtype: with bf16 params every
+    microbatch add rounds to 8 mantissa bits.  A floating dtype (float32
+    is the mode Apex guarantees: the wgrad GEMM accumulates into a
+    persistent fp32 `main_grad`, reference
+    transformer/tensor_parallel/layers.py:415-428) switches to explicit
+    per-microbatch value_and_grad with the running sum held in that
+    dtype; the returned grads ARE the main grads (mean over
+    microbatches, in main_grad_dtype).  Cost: the per-leaf cast+add
+    chain and an fp32 grad buffer — measured step-time numbers in
+    docs/PERF.md (round 6).
+    """
+    if main_grad_dtype is None:
+        def total_loss(p):
+            acc, _ = lax.scan(
+                lambda a, mb: (a + forward_step_func(p, mb), None),
+                jnp.zeros((), jnp.float32), batch)
+            return acc / num_microbatches
+
+        loss, grads = jax.value_and_grad(total_loss)(model_params)
+        return loss, grads
+
+    dt = jnp.dtype(main_grad_dtype)
+
+    def body(carry, mb):
+        loss_acc, g_acc = carry
+        loss, g = jax.value_and_grad(
+            lambda p: forward_step_func(p, mb))(model_params)
+        g_acc = jax.tree_util.tree_map(
+            lambda a, gg: a + gg.astype(dt), g_acc, g)
+        return (loss_acc + loss.astype(jnp.float32), g_acc), None
+
+    zeros = jax.tree_util.tree_map(
+        lambda p: jnp.zeros(jnp.shape(p), dt), model_params)
+    (loss, grads), _ = lax.scan(
+        body, (jnp.zeros((), jnp.float32), zeros), batch)
+    inv = 1.0 / num_microbatches
+    grads = jax.tree_util.tree_map(lambda g: g * jnp.asarray(inv, dt),
+                                   grads)
+    return loss * inv, grads
 
 
 def forward_backward_pipelining_without_interleaving(

@@ -4,12 +4,32 @@
 logits are sharded over the vocab dim on the tp axis; the loss needs
 three collectives — max (pmax), sum-exp (psum), and the target-logit
 gather via a vocab-range mask (psum).  Label smoothing matches the
-reference (cross_entropy.py:100-118).  Backward is derived by AD through
-the collectives (the reference hand-writes it; XLA produces the same
-collective pattern).
+reference (cross_entropy.py:100-118).
+
+Two backward strategies:
+
+* unfused (the original): AD through the collectives.  AD of
+  `x = logits.astype(f32)` makes the saved residuals fp32 — at the
+  GPT bench shapes the (S, B, V) fp32 residual is the single largest
+  activation in the step (50304-wide vocab), and its write+read is
+  pure HBM traffic the MXU never touches.
+* fused (`custom_vjp`, ≡ the reference's hand-written backward and the
+  xentropy_cuda kernel, which consumes HALF logits with fp32 internal
+  math): forward saves only the COMPUTE-dtype logits plus the fp32
+  log-sum-exp row; backward reconstructs softmax(x) − q in fp32
+  on the fly and emits the cotangent directly in the logits dtype.
+  With bf16 logits this halves the xent residual memory and its HBM
+  round trip — the round-6 per-GEMM roofline showed the LM-head+xent
+  row's gap to its GEMM roofline was exactly this epilogue traffic
+  (docs/PERF.md).
+
+`fused=None` (default) auto-selects: fused for sub-fp32 logits (the
+bf16 hot path), unfused for fp32 (bit-identical to previous rounds).
 """
 
 from __future__ import annotations
+
+import functools
 
 import jax
 import jax.numpy as jnp
@@ -20,12 +40,7 @@ from apex_tpu.parallel.collectives import (
 from apex_tpu.parallel.mesh import TP_AXIS
 
 
-def vocab_parallel_cross_entropy(local_logits, labels, smoothing: float = 0.0,
-                                 axis_name: str = TP_AXIS):
-    """Per-token loss from vocab-sharded logits.
-
-    local_logits: (..., V/p) this rank's shard; labels: (...) global ids.
-    """
+def _unfused(local_logits, labels, smoothing, axis_name):
     x = local_logits.astype(jnp.float32)
     vocab_per = x.shape[-1]
     rank = lax.axis_index(axis_name)
@@ -60,3 +75,89 @@ def vocab_parallel_cross_entropy(local_logits, labels, smoothing: float = 0.0,
         smooth_loss = -mean_log_prob
         loss = (1.0 - smoothing) * loss + smoothing * smooth_loss
     return loss
+
+
+# ------------------------------ fused path -----------------------------------
+
+def _fused_forward(local_logits, labels, smoothing, axis_name):
+    """Primal forward.  Raw collectives are fine here: AD never sees this
+    function (custom_vjp), so no transpose double-counting can occur."""
+    x = local_logits.astype(jnp.float32)
+    vocab_per = x.shape[-1]
+    start = lax.axis_index(axis_name) * vocab_per
+
+    local_max = jnp.max(x, axis=-1)
+    global_max = lax.pmax(local_max, axis_name)
+    local_sum = jnp.sum(jnp.exp(x - global_max[..., None]), axis=-1)
+    global_sum = lax.psum(local_sum, axis_name)
+    lse = jnp.log(global_sum) + global_max
+
+    local_ids = labels - start
+    valid = (local_ids >= 0) & (local_ids < vocab_per)
+    safe_ids = jnp.where(valid, local_ids, 0)
+    picked = jnp.take_along_axis(x, safe_ids[..., None], axis=-1)[..., 0]
+    target_logit = lax.psum(jnp.where(valid, picked, 0.0), axis_name)
+
+    loss = lse - target_logit
+    if smoothing > 0:
+        vocab_size = vocab_per * lax.axis_size(axis_name)
+        sum_logits = lax.psum(jnp.sum(x, axis=-1), axis_name)
+        loss = ((1.0 - smoothing) * loss
+                + smoothing * (lse - sum_logits / vocab_size))
+    return loss, lse
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def _fused_xent(local_logits, labels, smoothing, axis_name):
+    loss, _ = _fused_forward(local_logits, labels, smoothing, axis_name)
+    return loss
+
+
+def _fused_xent_fwd(local_logits, labels, smoothing, axis_name):
+    loss, lse = _fused_forward(local_logits, labels, smoothing, axis_name)
+    # residuals: compute-dtype logits + one fp32 row per token — NOT the
+    # fp32 upcast of the logits (the AD path's dominant residual)
+    return loss, (local_logits, labels, lse)
+
+
+def _fused_xent_bwd(smoothing, axis_name, res, g):
+    local_logits, labels, lse = res
+    x = local_logits.astype(jnp.float32)
+    vocab_per = x.shape[-1]
+    start = lax.axis_index(axis_name) * vocab_per
+
+    # softmax(x) − q, entirely shard-local given the replicated lse; the
+    # loss is replicated over tp so every rank holds the same cotangent g
+    # and emits only its own shard's gradient (identity-bwd convention).
+    p = jnp.exp(x - lse[..., None])
+    local_ids = labels - start
+    valid = (local_ids >= 0) & (local_ids < vocab_per)
+    cols = lax.broadcasted_iota(jnp.int32, x.shape, x.ndim - 1)
+    onehot = (cols == jnp.where(valid, local_ids, -1)[..., None]
+              ).astype(jnp.float32)
+    q = (1.0 - smoothing) * onehot
+    if smoothing > 0:
+        q = q + smoothing / (vocab_per * lax.axis_size(axis_name))
+    dx = (g[..., None] * (p - q)).astype(local_logits.dtype)
+    return dx, None
+
+
+_fused_xent.defvjp(_fused_xent_fwd, _fused_xent_bwd)
+
+
+# ------------------------------ public API -----------------------------------
+
+def vocab_parallel_cross_entropy(local_logits, labels, smoothing: float = 0.0,
+                                 axis_name: str = TP_AXIS, fused=None):
+    """Per-token loss from vocab-sharded logits.
+
+    local_logits: (..., V/p) this rank's shard; labels: (...) global ids.
+    fused: None (auto — fused custom_vjp iff logits are sub-fp32),
+    True/False to force.  Both paths compute identical fp32 math; the
+    fused one saves compute-dtype residuals only (module docstring).
+    """
+    if fused is None:
+        fused = local_logits.dtype != jnp.float32
+    if fused:
+        return _fused_xent(local_logits, labels, float(smoothing), axis_name)
+    return _unfused(local_logits, labels, smoothing, axis_name)

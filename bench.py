@@ -421,8 +421,19 @@ def _run_isolated(metric):
     out = subprocess.run(
         [sys.executable, os.path.abspath(__file__), "--only", metric],
         capture_output=True, text=True, timeout=900, check=True)
-    line = out.stdout.strip().splitlines()[-1]
-    return json.loads(line)[metric]
+    # scan in REVERSE for the first line that parses to a dict holding
+    # the metric: a plugin/absl log line printed to stdout AFTER the
+    # JSON previously made splitlines()[-1] raise, silently defeating
+    # isolation (ADVICE r5)
+    for line in reversed(out.stdout.strip().splitlines()):
+        try:
+            d = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(d, dict) and metric in d:
+            return d[metric]
+    raise ValueError(
+        f"no JSON line containing {metric!r} in --only child stdout")
 
 
 _ONLY = {
@@ -431,12 +442,39 @@ _ONLY = {
 }
 
 
+def _kernel_smoke():
+    """Run the compiled-kernel smoke gates (examples/tpu_kernel_smoke.py)
+    in a subprocess and return (ok, fail_lines).  Once per bench run, so
+    a compiled-Mosaic regression is caught by the driver's JSON rather
+    than by hand (VERDICT r5 next-round #7).  On a CPU backend the
+    script skips (exit 0) — `kernel_smoke_ok` then just asserts the
+    harness itself imports and dispatches."""
+    import os
+    import subprocess
+
+    script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "examples", "tpu_kernel_smoke.py")
+    out = subprocess.run([sys.executable, script], capture_output=True,
+                         text=True, timeout=900)
+    # "FAIL " (with space) keeps the script's final "FAILURES: [...]"
+    # summary line from duplicating the per-kernel lines
+    fails = [l for l in out.stdout.splitlines() if l.startswith("FAIL ")]
+    return out.returncode == 0, fails[:8]
+
+
 def main():
     from apex_tpu.models.gpt import GPTConfig
 
     on_tpu = jax.default_backend() not in ("cpu",)
-    if len(sys.argv) == 3 and sys.argv[1] == "--only":
+    if "--only" in sys.argv[1:]:
+        if len(sys.argv) != 3 or sys.argv[1] != "--only":
+            print("usage: bench.py [--only METRIC]", file=sys.stderr)
+            sys.exit(2)
         metric = sys.argv[2]
+        if metric not in _ONLY:
+            print(f"unknown metric {metric}; choices: {sorted(_ONLY)}",
+                  file=sys.stderr)
+            sys.exit(2)
         if not on_tpu:
             # a --only child exists to give a TPU metric a fresh
             # process; landing on CPU here means backend acquisition
@@ -520,6 +558,14 @@ def main():
         result["long_context_32k_tokens_per_sec"] = round(lc_tps, 1)
     except Exception as e:
         result["long_context_error"] = repr(e)[:120]
+    try:
+        ok, fails = _kernel_smoke()
+        result["kernel_smoke_ok"] = ok
+        if fails:
+            result["kernel_smoke_failures"] = fails
+    except Exception as e:
+        result["kernel_smoke_ok"] = False
+        result["kernel_smoke_error"] = repr(e)[:120]
     print(json.dumps(result))
 
 

@@ -30,8 +30,10 @@ def _flag_value():
 
 
 def force_cpu_devices_from_argv():
-    """Consume --force-cpu-devices N (or =N) from sys.argv; no-op if
-    absent or 0."""
+    """Read --force-cpu-devices N (or =N) from sys.argv and act on it;
+    no-op if absent or 0.  The flag is deliberately LEFT in sys.argv
+    (module docstring) so the example's argparse can document and
+    record it."""
     raw = _flag_value()
     if raw is None:
         return

@@ -35,11 +35,51 @@ def main():
     ap.add_argument("--batch", type=int, default=8)
     ap.add_argument("--seq", type=int, default=512)
     ap.add_argument("--iters", type=int, default=10)
+    ap.add_argument("--batch-sweep", type=str, default=None,
+                    help="comma-separated batches to sweep (round 6: the "
+                    "b32 knee question — LAMB's pass is batch-invariant, "
+                    "so seq/s keeps rising until compile/HBM fails; "
+                    "e.g. '16,32,40,48')")
     args = ap.parse_args()
 
     on_tpu = jax.default_backend() not in ("cpu",)
     if not on_tpu:
         args.batch, args.seq, args.iters = 2, 64, 2
+
+    if args.batch_sweep:
+        if not on_tpu:
+            # the child self-clamps to b2/s64 off-TPU, so every point
+            # would be the same measurement wearing different labels
+            print("--batch-sweep needs a TPU backend; got "
+                  f"{jax.default_backend()}", file=_sys.stderr)
+            _sys.exit(2)
+        import subprocess
+        for b in (int(x) for x in args.batch_sweep.split(",") if x):
+            cmd = [_sys.executable, _os.path.abspath(__file__),
+                   "--batch", str(b), "--seq", str(args.seq),
+                   "--iters", str(args.iters)]
+            # fresh process per point: a failed compile (b64 round 4)
+            # must not poison the later points' allocator
+            try:
+                r = subprocess.run(cmd, capture_output=True, text=True,
+                                   timeout=1800)
+            except subprocess.TimeoutExpired:
+                print(f"b{b}: FAIL timeout (1800s)", flush=True)
+                continue
+            # reverse-scan for the JSON line (≡ bench._run_isolated): a
+            # plugin log line after the JSON must not eat the result
+            line = "<no json output>"
+            for cand in reversed(r.stdout.strip().splitlines()):
+                try:
+                    d = json.loads(cand)
+                except ValueError:
+                    continue
+                if isinstance(d, dict) and "metric" in d:
+                    line = cand
+                    break
+            print(f"b{b}: {line if r.returncode == 0 else 'FAIL ' + r.stderr.strip()[-120:]}",
+                  flush=True)
+        return
 
     M.destroy_model_parallel()
     mesh = M.initialize_model_parallel(devices=jax.devices()[:1])

@@ -15,11 +15,11 @@ import bench
 from apex_tpu.models.gpt import GPT2_1p3B, GPTConfig
 
 
-def point(name, batch, seq, remat, policy):
+def point(name, batch, seq, remat, policy, **cfg_kw):
     cfg = GPTConfig(vocab_size=50304, seq_len=seq, dropout=0.0,
                     dtype=jnp.bfloat16, logits_dtype=jnp.bfloat16,
                     remat=remat, remat_policy=policy,
-                    use_flash_attention=True, **GPT2_1p3B)
+                    use_flash_attention=True, **GPT2_1p3B, **cfg_kw)
     try:
         tps = bench._fused_tokens_per_sec(True, batch, seq, cfg,
                                           master_dtype=jnp.bfloat16)
@@ -51,3 +51,12 @@ if __name__ == "__main__":
     elif which == "d":
         point("s512 b8 no-remat", 8, 512, False, None)
         point("s512 b7 no-remat", 7, 512, False, None)
+    elif which == "e":
+        # round 6: batch knee around the r5 best (b7 no-remat) now that
+        # the fused bf16 xent freed the fp32 (S,B,V) xent residual, and
+        # a fused-xent A/B at the same point
+        point("b7 no-remat (r5 best)", 7, 512, False, None)
+        point("b8 no-remat", 8, 512, False, None)
+        point("b9 no-remat", 9, 512, False, None)
+        point("b7 UNfused xent", 7, 512, False, None, fused_xent=False)
+        point("b8 dots", 8, 512, True, "dots")

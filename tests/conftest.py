@@ -22,9 +22,18 @@ if "xla_force_host_platform_device_count" not in _flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    # older jax (< 0.5) has no jax_num_cpu_devices option; the
+    # XLA_FLAGS --xla_force_host_platform_device_count set above
+    # (before the jax import) provides the 8-way virtual mesh there
+    pass
 jax.config.update("jax_enable_x64", False)
 assert jax.device_count() == 8, jax.devices()
+
+from apex_tpu import _compat  # noqa: E402,F401 — jax version shims
+# (must run before test modules execute `from jax import shard_map`)
 
 import pytest  # noqa: E402
 

@@ -61,3 +61,82 @@ def test_run_isolated_propagates_child_failure(monkeypatch):
     monkeypatch.setattr(sp, "run", fake_run)
     with pytest.raises(sp.CalledProcessError):
         bench._run_isolated("resnet50_img_per_sec")
+
+
+def test_run_isolated_skips_trailing_log_lines(monkeypatch):
+    """A plugin/absl log line printed AFTER the JSON must not defeat
+    isolation (ADVICE r5): the parser scans in reverse for the first
+    line that is a dict containing the metric."""
+    import subprocess as sp
+
+    def fake_run(cmd, **kw):
+        return types.SimpleNamespace(
+            stdout='{"resnet50_img_per_sec": 2310.4}\n'
+                   "I0000 plugin shutdown notice\n"
+                   "not json either\n",
+            returncode=0)
+
+    monkeypatch.setattr(sp, "run", fake_run)
+    assert bench._run_isolated("resnet50_img_per_sec") == 2310.4
+
+
+def test_run_isolated_no_json_raises(monkeypatch):
+    import subprocess as sp
+
+    def fake_run(cmd, **kw):
+        return types.SimpleNamespace(stdout="only logs\n", returncode=0)
+
+    monkeypatch.setattr(sp, "run", fake_run)
+    with pytest.raises(ValueError, match="resnet50_img_per_sec"):
+        bench._run_isolated("resnet50_img_per_sec")
+
+
+def test_only_wrong_arity_exits_with_usage(monkeypatch, capsys):
+    monkeypatch.setattr(sys, "argv", ["bench.py", "--only"])
+    with pytest.raises(SystemExit) as e:
+        bench.main()
+    assert e.value.code == 2
+    assert "usage" in capsys.readouterr().err
+
+
+def test_only_unknown_metric_lists_choices(monkeypatch, capsys):
+    monkeypatch.setattr(sys, "argv", ["bench.py", "--only", "nope"])
+    with pytest.raises(SystemExit) as e:
+        bench.main()
+    assert e.value.code == 2
+    err = capsys.readouterr().err
+    assert "unknown metric nope" in err
+    assert "resnet50_img_per_sec" in err
+
+
+def test_only_valid_metric_on_cpu_backend_exits_3(monkeypatch, capsys):
+    """The CPU-fallback hard-exit (3) must survive the new validation:
+    the parent's fallback depends on it."""
+    monkeypatch.setattr(sys, "argv",
+                        ["bench.py", "--only", "resnet50_img_per_sec"])
+    with pytest.raises(SystemExit) as e:
+        bench.main()
+    assert e.value.code == 3
+
+
+def test_kernel_smoke_reports_ok_and_failures(monkeypatch):
+    import subprocess as sp
+
+    def fake_run(cmd, **kw):
+        assert cmd[1].endswith("tpu_kernel_smoke.py")
+        return types.SimpleNamespace(
+            stdout="OK   layer_norm\nFAIL xentropy: Boom\nFAILURES\n",
+            returncode=1)
+
+    monkeypatch.setattr(sp, "run", fake_run)
+    ok, fails = bench._kernel_smoke()
+    assert ok is False
+    # per-kernel lines only — the "FAILURES: [...]" summary is excluded
+    assert fails == ["FAIL xentropy: Boom"]
+
+    def fake_ok(cmd, **kw):
+        return types.SimpleNamespace(stdout="ALL OK\n", returncode=0)
+
+    monkeypatch.setattr(sp, "run", fake_ok)
+    ok, fails = bench._kernel_smoke()
+    assert ok is True and fails == []

@@ -53,9 +53,11 @@ def test_bucketed_matches_plain():
 
 def test_make_train_step_matches_full_batch():
     mesh = M.initialize_model_parallel()  # dp=8
-    k = jax.random.PRNGKey(0)
     w_true = jnp.array([[2.0], [-3.0]])
-    X = jax.random.normal(k, (32, 2))
+    # numpy RNG: jax.random output differs across jax versions, and the
+    # 10-step convergence margin below is data-dependent
+    X = jnp.asarray(np.random.default_rng(3).normal(size=(32, 2)),
+                    jnp.float32)
     Y = X @ w_true
 
     def loss_fn(params, batch):
