@@ -15,6 +15,7 @@ Subpackages (lazily importable):
   parallel     — mesh/collectives/DP/SyncBN/LARC (≡ apex.parallel)
   transformer  — TP/SP/PP library (≡ apex.transformer)
   models       — flagship end-to-end models (ResNet, GPT, BERT)
+  monitor      — on-device metrics pytree + host sinks + profiler capture
 """
 
 import logging as _logging
@@ -76,7 +77,7 @@ from apex_tpu import transformer  # noqa: E402,F401
 
 _LAZY_SUBMODULES = {
     # reference name parity (apex/__init__.py lazy subpackages)
-    "contrib", "fp16_utils", "models", "normalization", "mlp",
+    "contrib", "fp16_utils", "models", "monitor", "normalization", "mlp",
     "fused_dense", "multi_tensor_apply", "checkpoint", "rnn",
 }
 

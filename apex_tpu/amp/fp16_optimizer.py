@@ -43,17 +43,39 @@ class FP16_Optimizer:
         the caller's jax.grad)."""
         return scaler_lib.scale_loss(self.scaler_state, loss)
 
-    def step(self, state, grads, lr=None, max_grad_norm=None):
+    def step(self, state, grads, lr=None, max_grad_norm=None,
+             metrics=None, metrics_count_step: bool = True):
         """Unscale, (optionally clip), masked step, update scaler.
-        Returns (params, state)."""
+        Returns (params, state) — or (params, state, new_metrics) when
+        a `monitor.MetricsState` is passed: loss scale, the unscaled
+        PRE-clip grad norm, overflow/skip counts, and master
+        param/update norms fold in on-device (this facade holds no
+        loss, so that field carries over).  Pass
+        metrics_count_step=False when another hook (e.g.
+        forward_backward_no_pipelining) already counts this iteration's
+        step — otherwise each iteration advances `step` twice and every
+        derived rate halves."""
+        scale_used = self.scaler_state.scale
         grads, found_inf = scaler_lib.unscale(self.scaler_state, grads)
+        # telemetry wants the PRE-clip norm: a clipped norm pins at the
+        # threshold and can never show the spike clipping exists to tame
+        grads_preclip = grads
         if max_grad_norm:
             grads, _ = clip_grad_norm(grads, max_grad_norm)
         params, new_state = self.optimizer.step(
             state, grads, lr=lr, found_inf=found_inf)
         self.scaler_state = scaler_lib.update(
             self.scaler_state, found_inf, dynamic=self.dynamic)
-        return params, new_state
+        if metrics is None:
+            return params, new_state
+        from apex_tpu.monitor import metrics as _mon
+        new_metrics = _mon.update_metrics(
+            metrics, grads=grads_preclip,  # unscaled, pre-clip
+            params_flat=getattr(state, "params", None),
+            new_params_flat=getattr(new_state, "params", None),
+            loss_scale=scale_used, found_inf=found_inf,
+            count_step=metrics_count_step)
+        return params, new_state, new_metrics
 
     # -- checkpoint parity (fp16_optimizer.py state_dict incl. masters) --
     def state_dict(self, state):

@@ -1,0 +1,51 @@
+"""apex_tpu.monitor — on-device training telemetry (ISSUE 2).
+
+Three layers:
+
+  * metrics  — `MetricsState`, a tiny all-scalar pytree that rides
+               INSIDE jitted train steps (no host syncs to collect);
+               the hot paths (`parallel.ddp.make_train_step`,
+               `schedules.forward_backward_no_pipelining`,
+               `amp.FP16_Optimizer.step`) thread it via their optional
+               `metrics=` hooks
+  * logger   — host-side `MetricsLogger` + sinks (JSONL / console /
+               SummaryWriter adapter) + derived rates (step time,
+               tokens/sec, MFU from `monitor.flops` accounting)
+  * profiler — `profile_capture(step_range)`: jax.profiler trace armed
+               over a chosen step window
+
+See docs/observability.md for the JSONL schema and recipes, and
+examples/train_with_monitor.py for the end-to-end loop.
+"""
+
+from apex_tpu.monitor import flops  # noqa: F401
+from apex_tpu.monitor.flops import (  # noqa: F401
+    V5E_BF16_PEAK,
+    bert_step_flops,
+    gpt_step_flops,
+    mfu,
+    transformer_step_flops,
+)
+from apex_tpu.monitor.logger import (  # noqa: F401
+    SCHEMA,
+    SCHEMA_VERSION,
+    MetricsLogger,
+    validate_record,
+    validate_records,
+)
+from apex_tpu.monitor.metrics import (  # noqa: F401
+    MetricsConfig,
+    MetricsState,
+    global_norm,
+    infer_tokens_per_step,
+    init_metrics,
+    update_metrics,
+)
+from apex_tpu.monitor.profiler import ProfileCapture, profile_capture  # noqa: F401
+from apex_tpu.monitor.sinks import (  # noqa: F401
+    ConsoleSink,
+    JSONLSink,
+    MetricSink,
+    ScalarWriter,
+    SummaryWriterSink,
+)

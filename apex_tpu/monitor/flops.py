@@ -1,0 +1,70 @@
+"""Per-model FLOP accounting for MFU — the formulas of
+`scripts/gpt_anatomy.py` (round 6 roofline anatomy) packaged as a
+library so `MetricsLogger` can derive MFU from step time.
+
+Conventions match the anatomy script exactly so MFU here agrees with
+the committed roofline tables in docs/PERF.md:
+
+  * every GEMM counts 2*M*K*N, and fwd+bwd counts 3x fwd (dgrad +
+    wgrad are the two transposed matmuls of the backward);
+  * attention scores/context count the FULL S x S square even for
+    causal models — at the bench block configs the flash kernel
+    executes the full square (gpt_anatomy.py module docstring), so
+    this is executed-flop MFU, not a 2x-flattering "causal" MFU;
+  * LayerNorm/softmax/optimizer FLOPs are omitted (sub-1% and
+    bandwidth-bound).
+"""
+
+from __future__ import annotations
+
+# v5e bf16 matmul peak — the PEAK constant of scripts/gpt_anatomy.py.
+V5E_BF16_PEAK = 197e12
+
+
+def transformer_step_flops(*, hidden: int, num_layers: int,
+                           num_heads: int, vocab_size: int, batch: int,
+                           seq: int, ffn_mult: int = 4,
+                           with_head: bool = True) -> int:
+    """Fwd+bwd FLOPs of one training step of a standard pre-LN
+    transformer (GPT/BERT body): QKV+out projections, S x S attention,
+    ffn_mult MLP, optional tied LM head."""
+    b, s, h, l = batch, seq, hidden, num_layers
+    d = hidden // num_heads
+    proj = 2 * b * s * h * 4 * h            # qkv (3h) + out (h) GEMMs
+    sdpa = 2 * b * num_heads * s * s * d * 2  # scores + context
+    attn = (proj + sdpa) * 3
+    mlp = 2 * b * s * h * (2 * ffn_mult * h) * 3   # up + down GEMMs
+    total = (attn + mlp) * l
+    if with_head:
+        total += 2 * b * s * h * vocab_size * 3
+    return int(total)
+
+
+def gpt_step_flops(config, batch: int, seq=None) -> int:
+    """Step FLOPs for a `models.gpt.GPTConfig` (seq defaults to the
+    config's seq_len)."""
+    return transformer_step_flops(
+        hidden=config.hidden, num_layers=config.num_layers,
+        num_heads=config.num_heads, vocab_size=config.vocab_size,
+        batch=batch, seq=config.seq_len if seq is None else seq,
+        ffn_mult=config.ffn_mult, with_head=True)
+
+
+def bert_step_flops(config, batch: int, seq=None) -> int:
+    """Step FLOPs for a `models.bert.BertConfig` (MLM head = the same
+    tied vocab GEMM; the NSP head is negligible)."""
+    return transformer_step_flops(
+        hidden=config.hidden, num_layers=config.num_layers,
+        num_heads=config.num_heads, vocab_size=config.vocab_size,
+        batch=batch, seq=config.seq_len if seq is None else seq,
+        ffn_mult=getattr(config, "ffn_mult", 4), with_head=True)
+
+
+def mfu(flops_per_step: float, step_time_s: float,
+        peak_flops: float = V5E_BF16_PEAK) -> float:
+    """Model FLOP utilization in [0, inf): achieved model FLOP/s over
+    the hardware peak.  >1 means the accounting under-counts (or the
+    peak is wrong for the backend)."""
+    if step_time_s <= 0 or peak_flops <= 0:
+        return 0.0
+    return flops_per_step / step_time_s / peak_flops

@@ -1,0 +1,173 @@
+"""`MetricsLogger` — the host side of the telemetry loop.
+
+The jitted step accumulates a `MetricsState`; the logger device_gets it
+(the ONLY host sync, and only at log time), derives the host-side rates
+the device cannot know — step time, tokens/sec, MFU — and fans a flat,
+schema-versioned record out to sinks.
+
+Schema: every record is a flat JSON object carrying
+`monitor_schema_version`; `validate_record`/`validate_records` are the
+single source of truth used by the tests, the example, and bench.py.
+Bump SCHEMA_VERSION whenever a field is added/renamed so BENCH/JSONL
+trajectories across rounds stay comparable (ISSUE 2 satellite).
+"""
+
+from __future__ import annotations
+
+import math
+import time
+from typing import Optional, Sequence
+
+import jax
+
+from apex_tpu.monitor import flops as flops_lib
+from apex_tpu.monitor.metrics import MetricsState
+from apex_tpu.monitor.sinks import MetricSink, ScalarWriter
+
+SCHEMA_VERSION = 1
+
+# field -> (python type, finite_required).  loss_scale may legitimately
+# be large but is finite; grad/update norms are inf/nan ON overflow
+# steps, so they are only finite-required when the step didn't overflow
+# (validate_record handles the conditional).
+SCHEMA = {
+    "monitor_schema_version": (int, True),
+    "step": (int, True),
+    "loss": (float, True),
+    "grad_norm": (float, False),      # finite unless overflow_delta > 0
+    "param_norm": (float, True),
+    "update_norm": (float, True),
+    "loss_scale": (float, True),
+    "overflow_count": (int, True),
+    "skipped_steps": (int, True),
+    "tokens_seen": (float, True),
+    "step_time_ms": (float, True),
+    "tokens_per_sec": (float, True),
+    "mfu": (float, True),
+}
+
+
+def validate_record(record: dict, prev_step: Optional[int] = None) -> None:
+    """Raise ValueError unless `record` matches SCHEMA: all fields
+    present, right types, finite where finiteness is expected, and
+    step > prev_step when given.  Extra keys are allowed (bench.py adds
+    its own)."""
+    if record.get("monitor_schema_version") != SCHEMA_VERSION:
+        raise ValueError(
+            f"monitor_schema_version {record.get('monitor_schema_version')!r}"
+            f" != {SCHEMA_VERSION}")
+    overflowed = record.get("overflowed_this_window", False)
+    for name, (typ, finite) in SCHEMA.items():
+        if name not in record:
+            raise ValueError(f"missing field {name!r}")
+        v = record[name]
+        if typ is float and isinstance(v, int) and not isinstance(v, bool):
+            v = float(v)  # JSON round-trips 1.0 as 1
+        if not isinstance(v, typ) or isinstance(v, bool):
+            raise ValueError(f"field {name!r} is {type(record[name]).__name__},"
+                             f" want {typ.__name__}")
+        if typ is float and finite and not math.isfinite(v):
+            raise ValueError(f"field {name!r} non-finite: {v}")
+        if name == "grad_norm" and not overflowed and not math.isfinite(v):
+            raise ValueError(f"grad_norm non-finite ({v}) on a step that "
+                             "did not overflow")
+    if record["step"] < 0:
+        raise ValueError(f"negative step {record['step']}")
+    if prev_step is not None and record["step"] <= prev_step:
+        raise ValueError(
+            f"non-monotonic step: {record['step']} after {prev_step}")
+
+
+def validate_records(records: Sequence[dict]) -> None:
+    """validate_record over a trajectory, enforcing monotonic steps."""
+    prev = None
+    for r in records:
+        validate_record(r, prev_step=prev)
+        prev = r["step"]
+
+
+class MetricsLogger:
+    """Derive rates + write records.
+
+    flops_per_step enables MFU (use `monitor.flops.gpt_step_flops` et
+    al.); peak_flops defaults to the v5e bf16 peak that
+    scripts/gpt_anatomy.py scores against.  `.writer` is a
+    SummaryWriter-compatible `ScalarWriter` over the SAME sinks, so
+    `Timers.write(names, logger.writer, iteration)` interleaves timer
+    scalars into the same stream.
+    """
+
+    def __init__(self, sinks: Sequence[MetricSink], *,
+                 flops_per_step: Optional[float] = None,
+                 peak_flops: float = flops_lib.V5E_BF16_PEAK):
+        self.sinks = list(sinks)
+        self.flops_per_step = flops_per_step
+        self.peak_flops = peak_flops
+        self.writer = ScalarWriter(*self.sinks)
+        self._last_t = time.perf_counter()
+        self._last_step = 0
+        self._last_tokens = 0.0
+        self._last_overflows = 0
+
+    def reset_timer(self, metrics: Optional[MetricsState] = None) -> None:
+        """Restart the rate window (call after warmup/compile so the
+        first logged step_time is not dominated by compilation).  Pass
+        the current MetricsState when warmup steps were COUNTED in the
+        pytree: the step/token/overflow baselines resync to it —
+        otherwise the first window divides by the warmup's extra steps
+        and under-reports step time / inflates tokens-per-sec."""
+        self._last_t = time.perf_counter()
+        if metrics is not None:
+            m = jax.device_get(metrics)
+            self._last_step = int(m.step)
+            self._last_tokens = float(m.tokens_seen)
+            self._last_overflows = int(m.overflow_count)
+
+    def log_step(self, metrics: MetricsState, extra: Optional[dict] = None,
+                 ) -> dict:
+        """device_get the pytree, derive rates over the window since the
+        previous log_step, write to all sinks, return the record."""
+        m = jax.device_get(metrics)
+        now = time.perf_counter()
+        step = int(m.step)
+        d_steps = max(1, step - self._last_step)
+        dt = max(now - self._last_t, 1e-12)
+        d_tokens = float(m.tokens_seen) - self._last_tokens
+        overflows = int(m.overflow_count)
+        record = {
+            "monitor_schema_version": SCHEMA_VERSION,
+            "step": step,
+            "loss": float(m.loss),
+            "grad_norm": float(m.grad_norm),
+            "param_norm": float(m.param_norm),
+            "update_norm": float(m.update_norm),
+            "loss_scale": float(m.loss_scale),
+            "overflow_count": overflows,
+            "skipped_steps": int(m.skipped_steps),
+            "tokens_seen": float(m.tokens_seen),
+            "step_time_ms": dt / d_steps * 1e3,
+            "tokens_per_sec": d_tokens / dt,
+            "mfu": (flops_lib.mfu(self.flops_per_step, dt / d_steps,
+                                  self.peak_flops)
+                    if self.flops_per_step else 0.0),
+            "overflowed_this_window": overflows > self._last_overflows,
+        }
+        if extra:
+            record.update(extra)
+        for s in self.sinks:
+            s.write(record)
+        self._last_t = now
+        self._last_step = step
+        self._last_tokens = float(m.tokens_seen)
+        self._last_overflows = overflows
+        return record
+
+    def close(self) -> None:
+        for s in self.sinks:
+            s.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

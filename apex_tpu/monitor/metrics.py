@@ -1,0 +1,170 @@
+"""`MetricsState` — the on-device telemetry pytree.
+
+The design constraint (ROADMAP: observability must not perturb the
+compiled program, ≡ veScale's non-intrusive tracking, PAPERS arxiv
+2509.07003) is that collection happens INSIDE the jitted train step:
+every field is a scalar computed from values the step already holds
+(loss, synced grads, the flat master buffer, the loss-scaler state), so
+enabling metrics adds a handful of fused scalar reductions and ZERO
+host syncs.  The host only touches the pytree when `MetricsLogger`
+device_gets it at log time.
+
+All fields are f32/i32 scalars so the pytree jits, shards (replicated,
+`P()`), donates, and checkpoints like any other state.  `tokens_seen`
+is f32: exact up to 2**24, then rounds to the nearest representable —
+fine for rate math, documented in docs/observability.md.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+class MetricsState(NamedTuple):
+    """Per-step telemetry riding inside the jitted step (one scalar
+    leaf each — the whole pytree is < 50 bytes)."""
+
+    step: jnp.ndarray            # i32, steps attempted (incl. skipped)
+    loss: jnp.ndarray            # f32, last UNSCALED loss
+    grad_norm: jnp.ndarray       # f32, global L2 of unscaled synced grads
+    param_norm: jnp.ndarray      # f32, global L2 of master params
+    update_norm: jnp.ndarray     # f32, global L2 of the applied update
+    loss_scale: jnp.ndarray      # f32, current loss scale (1.0 if none)
+    overflow_count: jnp.ndarray  # i32, cumulative non-finite-grad steps
+    # today every overflow is skipped and nothing else is, so the two
+    # counters track together; they are separate fields (per ISSUE 2's
+    # schema) so future skip policies (nan-loss skip, clip-based skip)
+    # can diverge without a schema bump
+    skipped_steps: jnp.ndarray   # i32, cumulative optimizer-skip steps
+    tokens_seen: jnp.ndarray     # f32, cumulative tokens (or samples)
+
+
+@dataclasses.dataclass(frozen=True)
+class MetricsConfig:
+    """Static knobs for in-step collection.
+
+    tokens_per_step: global tokens consumed per optimizer step.  None
+    infers from the batch at trace time: integer-dtyped (B, S, ...)
+    leaves count B*S (LM token batches), float leaves count B samples
+    (image batches) — times the dp axis size inside make_train_step.
+    param_norms: the param/update norms read the optimizer's flat
+    master buffer (two extra full-buffer reductions per step); disable
+    for memory-bound steps where 2 passes over the master buffer show
+    up.
+    """
+
+    tokens_per_step: Optional[int] = None
+    param_norms: bool = True
+
+
+def init_metrics() -> MetricsState:
+    z32 = jnp.zeros((), jnp.float32)
+    zi = jnp.zeros((), jnp.int32)
+    return MetricsState(step=zi, loss=z32, grad_norm=z32, param_norm=z32,
+                        update_norm=z32, loss_scale=jnp.ones((), jnp.float32),
+                        overflow_count=zi, skipped_steps=zi, tokens_seen=z32)
+
+
+def global_norm(tree) -> jnp.ndarray:
+    """Global L2 norm over a pytree, accumulated in f32 (bf16 leaves
+    upcast per-leaf before squaring).  XLA fuses the per-leaf partial
+    sums into the surrounding step.  Deliberately NOT
+    K.l2norm_flat(F.flatten(...)) (clip_grad's path): flatten
+    materializes a full concatenated grad copy per step, which is
+    exactly the overhead telemetry must not add — at the cost that this
+    norm may differ from the clip norm in the last few ULPs
+    (accumulation order)."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    if not leaves:
+        return jnp.zeros((), jnp.float32)
+    sq = sum(jnp.sum(jnp.square(l.astype(jnp.float32))) for l in leaves)
+    return jnp.sqrt(sq)
+
+
+def infer_tokens_per_step(batch, microbatch_dims: int = 0) -> int:
+    """Trace-time token accounting for one step's LOCAL batch (callers
+    multiply by the dp axis size).  Heuristic on the FIRST leaf:
+    integer-dtyped leaves with a sequence dim are LM token ids and count
+    every element; float leaves (images etc.) count samples.
+    `microbatch_dims=1` for batches stacked (num_microbatches, mb, ...).
+    Pass an explicit tokens_per_step when the heuristic is wrong (e.g.
+    a dict whose first leaf is a 1-D label vector)."""
+    leaves = jax.tree_util.tree_leaves(batch)
+    if not leaves:
+        return 0
+    lead = leaves[0]
+    if (jnp.issubdtype(lead.dtype, jnp.integer)
+            and lead.ndim >= 2 + microbatch_dims):
+        n = 1
+        for d in lead.shape:
+            n *= int(d)
+        return n
+    n = 1
+    for d in lead.shape[:1 + microbatch_dims]:
+        n *= int(d)
+    return n
+
+
+def update_metrics(state: MetricsState, *, loss=None, grads=None,
+                   inv_scale=1.0, params_flat=None, new_params_flat=None,
+                   loss_scale=None, found_inf=None,
+                   tokens: int = 0,
+                   count_step: bool = True) -> MetricsState:
+    """Fold one step's signals into the pytree — call INSIDE the jitted
+    step.  Every argument is optional: paths that don't hold a signal
+    (e.g. `forward_backward_no_pipelining` has no optimizer state) leave
+    that field at its previous value.
+
+    count_step=False updates fields WITHOUT advancing `step` — for the
+    second hook when two hooks fire per training iteration (e.g.
+    forward_backward_no_pipelining for loss/grad-norm, then
+    FP16_Optimizer.step(metrics_count_step=False) for scale/norms);
+    double-counting halves every derived rate downstream.
+
+    grads are the step's (possibly still loss-scaled) gradients;
+    `inv_scale` unscales the recorded norm.  params_flat /
+    new_params_flat are the optimizer's flat master buffers before and
+    after the update (`FusedAdamState.params` etc.) — the update norm is
+    computed as their difference, no per-leaf tree needed.
+    """
+    if not isinstance(state, MetricsState):
+        raise TypeError(
+            f"update_metrics needs a MetricsState, got "
+            f"{type(state).__name__}; build one with init_metrics() "
+            "(make_train_step's build-time metrics= flag is the one "
+            "place that takes True/MetricsConfig instead)")
+    step = state.step + (1 if count_step else 0)
+    loss_v = state.loss if loss is None else \
+        jnp.asarray(loss, jnp.float32).reshape(())
+    if grads is not None:
+        gn = global_norm(grads) * jnp.asarray(inv_scale, jnp.float32)
+    else:
+        gn = state.grad_norm
+    if params_flat is not None:
+        pn = jnp.linalg.norm(params_flat.astype(jnp.float32))
+    else:
+        pn = state.param_norm
+    if new_params_flat is not None and params_flat is not None:
+        un = jnp.linalg.norm(
+            (new_params_flat.astype(jnp.float32)
+             - params_flat.astype(jnp.float32)))
+    else:
+        un = state.update_norm
+    scale_v = state.loss_scale if loss_scale is None else \
+        jnp.asarray(loss_scale, jnp.float32).reshape(())
+    if found_inf is not None:
+        inc = jnp.asarray(found_inf).astype(jnp.int32).reshape(())
+        overflow = state.overflow_count + inc
+        skipped = state.skipped_steps + inc
+    else:
+        overflow = state.overflow_count
+        skipped = state.skipped_steps
+    return MetricsState(
+        step=step, loss=loss_v, grad_norm=gn, param_norm=pn,
+        update_norm=un, loss_scale=scale_v, overflow_count=overflow,
+        skipped_steps=skipped,
+        tokens_seen=state.tokens_seen + jnp.asarray(tokens, jnp.float32))

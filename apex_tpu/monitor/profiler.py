@@ -1,0 +1,85 @@
+"""Profiler capture scoped to a step window.
+
+`profile_capture(range(10, 13), logdir=...)` arms a `jax.profiler`
+trace that starts when the first step of the window begins and stops
+after its last step — the usual "skip compile, grab 3 steady-state
+steps" workflow, without littering the training loop with
+start/stop_trace calls:
+
+    cap = monitor.profile_capture(range(3, 6), logdir="/tmp/trace")
+    for i in range(steps):
+        with cap.step(i):
+            state, ... = train_step(...)
+    cap.close()   # safety net if the loop exits early
+
+Each captured step is wrapped in a trace annotation (default name
+"train-step"); phase timers used inside the step already emit
+`TraceAnnotation`s with their own `_Timer` names (utils/timers.py), so
+the profile shows the same names `Timers.log` prints.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Iterable, Optional
+
+import jax
+
+
+class ProfileCapture:
+    def __init__(self, step_range: Iterable[int], *,
+                 logdir: str = "/tmp/apex_tpu_trace",
+                 annotation: str = "train-step"):
+        steps = sorted(set(int(s) for s in step_range))
+        self._first = steps[0] if steps else None
+        self._last = steps[-1] if steps else None
+        self.logdir = logdir
+        self.annotation = annotation
+        self._active = False
+
+    @property
+    def active(self) -> bool:
+        return self._active
+
+    @contextlib.contextmanager
+    def step(self, i: int):
+        """Wrap one training step; starts/stops the trace at the window
+        edges and annotates the step body."""
+        if (not self._active and self._first is not None
+                and self._first <= i <= self._last):
+            jax.profiler.start_trace(self.logdir)
+            self._active = True
+        if self._active:
+            # StepTraceAnnotation groups the step in the trace viewer's
+            # step axis; older jax falls back to a plain annotation
+            mk = getattr(jax.profiler, "StepTraceAnnotation", None)
+            ann = (mk(self.annotation, step_num=i) if mk is not None
+                   else jax.profiler.TraceAnnotation(self.annotation))
+        else:
+            ann = contextlib.nullcontext()
+        try:
+            with ann:
+                yield self
+        finally:
+            if self._active and i >= self._last:
+                self.close()
+
+    def close(self) -> None:
+        """Stop the trace if armed (idempotent)."""
+        if self._active:
+            self._active = False
+            jax.profiler.stop_trace()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def profile_capture(step_range: Iterable[int], *,
+                    logdir: str = "/tmp/apex_tpu_trace",
+                    annotation: str = "train-step") -> ProfileCapture:
+    """Build a `ProfileCapture` for the given step window (see module
+    docstring for the loop idiom)."""
+    return ProfileCapture(step_range, logdir=logdir, annotation=annotation)

@@ -1,0 +1,139 @@
+"""Host-side metric sinks: where `MetricsLogger` records go.
+
+Three built-ins cover the reference workflows:
+
+  * JSONLSink   — one JSON object per step, append-only; the schema is
+                  versioned (`logger.SCHEMA_VERSION`) and validated by
+                  tests and bench.py
+  * ConsoleSink — the reference's periodic "iteration … | loss … |
+                  loss scale …" line (≡ Megatron/apex training_log)
+  * SummaryWriterSink — wraps anything with the TensorBoard
+                  `SummaryWriter.add_scalar(tag, value, step)` method
+
+and `ScalarWriter` is a minimal `SummaryWriter`-COMPATIBLE object
+(implements `add_scalar`) that fans out to sinks — so `Timers.write`,
+which expects a `SummaryWriter`, can target the monitor stack directly.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Callable, Optional
+
+
+class MetricSink:
+    """One record per logged step.  `write(record)` with a flat
+    str→scalar dict; `close()` flushes/releases resources."""
+
+    def write(self, record: dict) -> None:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class JSONLSink(MetricSink):
+    """One JSON line per record; flushed per record so a killed run
+    keeps every completed step.  Truncates by default — a fresh run's
+    steps restart at 1, and appending onto an old trajectory would make
+    the file fail the package's own monotonic-step validation.  Pass
+    mode="a" when resuming a run whose step counter continues."""
+
+    def __init__(self, path, mode: str = "w"):
+        if mode not in ("w", "a"):
+            raise ValueError(f"mode must be 'w' or 'a', got {mode!r}")
+        self.path = os.fspath(path)
+        d = os.path.dirname(self.path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        self._f = open(self.path, mode)
+
+    def write(self, record: dict) -> None:
+        self._f.write(json.dumps(record) + "\n")
+        self._f.flush()
+
+    def close(self) -> None:
+        if not self._f.closed:
+            self._f.close()
+
+
+class ConsoleSink(MetricSink):
+    """One human line per record ≡ the reference's training_log string.
+    `print_fn` hooks a logger (e.g. `log_util` logger.info)."""
+
+    _ORDER = ("step", "loss", "grad_norm", "loss_scale", "step_time_ms",
+              "tokens_per_sec", "mfu")
+    _FMT = {"loss": "{:.4f}", "grad_norm": "{:.3e}", "loss_scale": "{:g}",
+            "step_time_ms": "{:.1f}", "tokens_per_sec": "{:,.0f}",
+            "mfu": "{:.1%}"}
+
+    def __init__(self, print_fn: Optional[Callable[[str], None]] = None):
+        self.print_fn = print_fn or print
+
+    def write(self, record: dict) -> None:
+        parts = []
+        for k in self._ORDER:
+            if k in record and record[k] is not None:
+                fmt = self._FMT.get(k, "{}")
+                parts.append(f"{k} {fmt.format(record[k])}")
+        if len(parts) <= 1:
+            return  # step-only record (e.g. a ScalarWriter timer tag)
+        self.print_fn(" | ".join(parts))
+
+
+class SummaryWriterSink(MetricSink):
+    """Forward every numeric field to a TensorBoard-style writer
+    (anything with `add_scalar(tag, value, step)`); `prefix` namespaces
+    the tags (`train/loss`, …)."""
+
+    def __init__(self, writer, prefix: str = "train/"):
+        if not hasattr(writer, "add_scalar"):
+            raise TypeError(
+                f"writer {type(writer).__name__} has no add_scalar; need "
+                "a SummaryWriter-compatible object")
+        self.writer = writer
+        self.prefix = prefix
+
+    def write(self, record: dict) -> None:
+        step = int(record.get("step", 0))
+        for k, v in record.items():
+            if k == "step" or not isinstance(v, (int, float)):
+                continue
+            self.writer.add_scalar(self.prefix + k, v, step)
+
+    def close(self) -> None:
+        if hasattr(self.writer, "flush"):
+            self.writer.flush()
+
+
+class ScalarWriter:
+    """Minimal `SummaryWriter`-compatible adapter over sinks.
+
+    Implements the one method this codebase's consumers use —
+    `add_scalar(tag, value, step)` (`Timers.write` calls exactly this)
+    — and emits each call as a one-field record `{"step": step, tag:
+    value}` to every sink.  Lets timer traces land in the same JSONL
+    stream as the step metrics.
+    """
+
+    def __init__(self, *sinks: MetricSink):
+        self.sinks = list(sinks)
+
+    def add_scalar(self, tag: str, value, step: int) -> None:
+        rec = {"step": int(step), tag: float(value)}
+        for s in self.sinks:
+            s.write(rec)
+
+    def flush(self) -> None:
+        pass
+
+    def close(self) -> None:
+        for s in self.sinks:
+            s.close()

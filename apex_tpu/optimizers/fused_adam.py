@@ -85,9 +85,12 @@ class FusedAdam(F.FlatCheckpointMixin):
             self._seg_wd, self._seg_lrs = F.resolve_per_leaf(
                 self.wd_mask, self.lr_scales, self.weight_decay, params,
                 type(self).__name__)
-        zeros = jnp.zeros_like(flat)
+        # two DISTINCT zero buffers: aliasing one array as both moments
+        # makes any later donating jit fail with "donate the same
+        # buffer twice" when the state is passed in un-resharded
         return FusedAdamState(step=jnp.zeros((), jnp.int32), params=flat,
-                              exp_avg=zeros, exp_avg_sq=zeros)
+                              exp_avg=jnp.zeros_like(flat),
+                              exp_avg_sq=jnp.zeros_like(flat))
 
     def step(self, state: FusedAdamState, grads, lr=None, inv_scale=1.0,
              found_inf=False):

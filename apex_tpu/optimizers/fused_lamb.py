@@ -76,9 +76,11 @@ class FusedLAMB(F.FlatCheckpointMixin):
             self._seg_wd, self._seg_lrs = F.resolve_per_leaf(
                 self.wd_mask, self.lr_scales, self.weight_decay, params,
                 type(self).__name__)
-        zeros = jnp.zeros_like(flat)
+        # distinct zero buffers (see fused_adam.init: an aliased pair
+        # breaks donating jits fed the fresh state)
         return FusedLAMBState(step=jnp.zeros((), jnp.int32), params=flat,
-                              exp_avg=zeros, exp_avg_sq=zeros)
+                              exp_avg=jnp.zeros_like(flat),
+                              exp_avg_sq=jnp.zeros_like(flat))
 
     def step(self, state: FusedLAMBState, grads, lr=None, inv_scale=1.0,
              found_inf=False):

@@ -124,7 +124,8 @@ def make_train_step(loss_fn: Callable, optimizer, mesh, *,
                     batch_spec=None, has_aux: bool = False,
                     with_state: bool = False,
                     num_microbatches: int = 1,
-                    main_grad_dtype=None):
+                    main_grad_dtype=None,
+                    metrics=None):
     """Build the fused data-parallel train step.
 
     `loss_fn(params, batch) -> loss` (or `(loss, aux)` with has_aux;
@@ -148,6 +149,16 @@ def make_train_step(loss_fn: Callable, optimizer, mesh, *,
     grads flow to the grad pmean and the fused optimizer as-is (the
     flat kernels take any float grad dtype).
 
+    metrics enables on-device telemetry (apex_tpu.monitor): pass True
+    or a `monitor.MetricsConfig`.  The returned step then takes a
+    trailing `monitor.MetricsState` argument and returns the updated
+    one as its LAST output — loss, unscaled global grad norm, master
+    param/update norms, loss scale, cumulative overflow/skip counts and
+    tokens are folded in INSIDE the jitted program (a few fused scalar
+    reductions, no host syncs; device_get only when the host logs).
+    When omitted (default) the built step is the identical program as
+    before — signature, outputs, and numerics unchanged.
+
     ≡ the reference hot loop: DDP.forward → amp.scale_loss → backward
     hooks/allreduce → FusedAdam.step (SURVEY §3.2-3.3), collapsed into
     one compiled program.
@@ -159,8 +170,19 @@ def make_train_step(loss_fn: Callable, optimizer, mesh, *,
     if num_microbatches < 1:
         raise ValueError(f"num_microbatches must be >= 1, got "
                          f"{num_microbatches}")
+    metrics_cfg = None
+    if metrics is not None and metrics is not False:
+        from apex_tpu.monitor import metrics as _mon
+        if isinstance(metrics, _mon.MetricsState):
+            raise TypeError(
+                "make_train_step(metrics=...) takes True or a "
+                "MetricsConfig at build time; pass the MetricsState to "
+                "the built step as its trailing argument")
+        metrics_cfg = _mon.MetricsConfig() if metrics is True else metrics
 
-    def local_step(opt_state, scaler_state, model_state, batch):
+    def local_step(opt_state, scaler_state, model_state, batch,
+                   metrics_state=None):
+        raw_batch = batch
         params = F.unflatten(opt_state.params, optimizer.spec)
         if policy is not None:
             params = policy.cast_to_param(params)
@@ -253,6 +275,31 @@ def make_train_step(loss_fn: Callable, optimizer, mesh, *,
         outs = outs + (loss,)
         if has_aux and not with_state:
             outs = outs + (aux,)
+        if metrics_cfg is not None:
+            from apex_tpu.monitor import metrics as _mon
+            if metrics_cfg.tokens_per_step is not None:
+                tokens = metrics_cfg.tokens_per_step
+            else:
+                tokens = (_mon.infer_tokens_per_step(raw_batch)
+                          * jax.lax.axis_size(axis_name))
+            # flat optimizers carry the master buffer as state.params;
+            # norms read it directly (no per-leaf tree walk)
+            p_flat = getattr(opt_state, "params", None)
+            p_new = getattr(new_opt_state, "params", None)
+            if not metrics_cfg.param_norms:
+                p_flat = p_new = None
+            # the step's `loss` output is each shard's LOCAL loss (the
+            # P() out-spec takes one shard's value under check_vma=False)
+            # — telemetry wants the global dp-mean; a scalar pmean costs
+            # nothing next to the grad sync and touches no other output
+            global_loss = jax.lax.pmean(loss, axis_name)
+            outs = outs + (_mon.update_metrics(
+                metrics_state, loss=global_loss, grads=grads,
+                inv_scale=inv,
+                params_flat=p_flat, new_params_flat=p_new,
+                loss_scale=scaler_state.scale if scaler_state is not None
+                else 1.0,
+                found_inf=found_inf, tokens=tokens),)
         return outs
 
     # batch sharded over dp; params/opt state replicated (ZeRO variants
@@ -267,9 +314,14 @@ def make_train_step(loss_fn: Callable, optimizer, mesh, *,
     if has_aux and not with_state:
         out_specs += (P(),)
 
+    in_specs = (P(), P(), P(), batch_spec)
+    if metrics_cfg is not None:
+        in_specs += (P(),)       # metrics pytree replicated
+        out_specs += (P(),)
+
     smapped = shard_map(
         local_step, mesh=mesh,
-        in_specs=(P(), P(), P(), batch_spec),
+        in_specs=in_specs,
         out_specs=out_specs,
         check_vma=False)
 
@@ -279,7 +331,12 @@ def make_train_step(loss_fn: Callable, optimizer, mesh, *,
     if with_state:
         return jitted
 
-    def step(opt_state, scaler_state, batch):
-        return jitted(opt_state, scaler_state, None, batch)
+    if metrics_cfg is not None:
+        def step(opt_state, scaler_state, batch, metrics_state):
+            return jitted(opt_state, scaler_state, None, batch,
+                          metrics_state)
+    else:
+        def step(opt_state, scaler_state, batch):
+            return jitted(opt_state, scaler_state, None, batch)
 
     return step

@@ -213,7 +213,8 @@ def _ring_shift(x, axis_name, delta):
 def forward_backward_no_pipelining(forward_step_func, batch, model_params, *,
                                    num_microbatches: int,
                                    grad_fn: Optional[Callable] = None,
-                                   main_grad_dtype=None):
+                                   main_grad_dtype=None,
+                                   metrics=None, tokens_per_step=None):
     """≡ fwd_bwd_no_pipelining.py:23-120: loop microbatches, average loss
     and accumulate grads (no_sync semantics are implicit — grads sync
     when the caller psums them once after this returns).
@@ -221,6 +222,18 @@ def forward_backward_no_pipelining(forward_step_func, batch, model_params, *,
     forward_step_func(params, microbatch) -> scalar loss.
     batch: pytree with leading dim num_microbatches.
     Returns (mean_loss, grads) via value_and_grad.
+
+    metrics: optional `monitor.MetricsState` — when passed, the return
+    becomes (mean_loss, grads, new_metrics) with loss, the LOCAL grad
+    norm (grads here are this shard's pre-psum accumulation — the
+    caller syncs after this returns, so under dp>1 this is NOT the
+    global post-sync norm the ddp path records), and token count
+    (tokens_per_step, or inferred from the microbatched batch) folded
+    in on-device; this path holds no scaler/optimizer state, so those
+    fields carry over unchanged — when a downstream
+    `FP16_Optimizer.step(metrics=...)` also runs each iteration, give
+    it metrics_count_step=False so the step counter advances once.
+    When omitted the function is byte-for-byte the old one.
 
     main_grad_dtype: None keeps the historical path — AD through the
     microbatch scan, whose cotangent carry (and therefore the
@@ -235,6 +248,15 @@ def forward_backward_no_pipelining(forward_step_func, batch, model_params, *,
     chain and an fp32 grad buffer — measured step-time numbers in
     docs/PERF.md (round 6).
     """
+    def finish(loss, grads):
+        if metrics is None:
+            return loss, grads
+        from apex_tpu.monitor import metrics as _mon
+        tokens = tokens_per_step if tokens_per_step is not None else \
+            _mon.infer_tokens_per_step(batch, microbatch_dims=1)
+        return loss, grads, _mon.update_metrics(
+            metrics, loss=loss, grads=grads, tokens=tokens)
+
     if main_grad_dtype is None:
         def total_loss(p):
             acc, _ = lax.scan(
@@ -243,7 +265,7 @@ def forward_backward_no_pipelining(forward_step_func, batch, model_params, *,
             return acc / num_microbatches
 
         loss, grads = jax.value_and_grad(total_loss)(model_params)
-        return loss, grads
+        return finish(loss, grads)
 
     dt = jnp.dtype(main_grad_dtype)
 
@@ -262,7 +284,7 @@ def forward_backward_no_pipelining(forward_step_func, batch, model_params, *,
     inv = 1.0 / num_microbatches
     grads = jax.tree_util.tree_map(lambda g: g * jnp.asarray(inv, dt),
                                    grads)
-    return loss * inv, grads
+    return finish(loss * inv, grads)
 
 
 def forward_backward_pipelining_without_interleaving(

@@ -30,10 +30,13 @@ class _Timer:
         self.started_ = True
 
     def stop(self, block: bool = False):
+        """block=True drains device execution before reading the clock
+        (≡ the reference's torch.cuda.synchronize, _timers.py:25-29) —
+        without it the wall clock measures dispatch, not execution."""
         assert self.started_, "timer is not started"
         if block:
             for d in jax.live_arrays():
-                pass
+                d.block_until_ready()
         self.elapsed_ += time.time() - self.start_time
         self.started_ = False
         self._trace.__exit__(None, None, None)
@@ -65,9 +68,21 @@ class Timers:
             self.timers[name] = _Timer(name)
         return self.timers[name]
 
+    def _get(self, name):
+        try:
+            return self.timers[name]
+        except KeyError:
+            raise KeyError(
+                f"unknown timer {name!r}; registered timers: "
+                f"{sorted(self.timers) or '(none)'}") from None
+
     def write(self, names, writer, iteration, normalizer=1.0, reset=False):
+        """Emit `<name>-time` scalars to a SummaryWriter-compatible
+        `writer` (anything with add_scalar — e.g. a real TensorBoard
+        writer, or `monitor.MetricsLogger.writer` to land timer scalars
+        in the metrics JSONL stream)."""
         for name in names:
-            value = self.timers[name].elapsed(reset=reset) / normalizer
+            value = self._get(name).elapsed(reset=reset) / normalizer
             writer.add_scalar(name + "-time", value, iteration)
 
     def log(self, names=None, normalizer=1.0, reset=True):
@@ -75,6 +90,6 @@ class Timers:
         names = names or list(self.timers)
         string = "time (ms)"
         for name in names:
-            t = self.timers[name].elapsed(reset=reset) * 1000.0 / normalizer
+            t = self._get(name).elapsed(reset=reset) * 1000.0 / normalizer
             string += f" | {name}: {t:.2f}"
         return string

@@ -19,6 +19,7 @@ fused-optimizer step time.
 
 from __future__ import annotations
 
+import contextlib
 import functools
 import json
 import sys
@@ -462,8 +463,23 @@ def _kernel_smoke():
     return out.returncode == 0, fails[:8]
 
 
+@contextlib.contextmanager
+def _timed(durations, name):
+    """Record a metric block's wall-clock seconds (errors included —
+    a 15-minute OOM-retry spiral should be visible in the trajectory)
+    into the JSON's `metric_durations_s` (ISSUE 2 satellite)."""
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        durations[name] = round(time.perf_counter() - t0, 2)
+
+
 def main():
     from apex_tpu.models.gpt import GPTConfig
+    # import up front (fail FAST, not after 30 min of TPU metrics): the
+    # version stamps the result JSON at the end of this function
+    from apex_tpu.monitor import SCHEMA_VERSION
 
     on_tpu = jax.default_backend() not in ("cpu",)
     if "--only" in sys.argv[1:]:
@@ -500,8 +516,10 @@ def main():
         cfg = GPTConfig(vocab_size=512, seq_len=seq, hidden=64,
                         num_layers=2, num_heads=4, dropout=0.0)
 
-    fused = _retry(_fused_tokens_per_sec, on_tpu, batch, seq, cfg,
-                   jnp.bfloat16 if on_tpu else jnp.float32)
+    durations = {}
+    with _timed(durations, "gpt350m_train_tokens_per_sec_per_chip"):
+        fused = _retry(_fused_tokens_per_sec, on_tpu, batch, seq, cfg,
+                       jnp.bfloat16 if on_tpu else jnp.float32)
     result = {
         "metric": "gpt350m_train_tokens_per_sec_per_chip",
         "value": round(fused, 1),
@@ -510,62 +528,75 @@ def main():
         "vs_baseline": None,  # measured below; null = baseline didn't run
     }
     try:
-        baseline, bl_batch = _retry(_baseline_best, on_tpu, batch, seq, cfg)
+        with _timed(durations, "baseline_tokens_per_sec"):
+            baseline, bl_batch = _retry(_baseline_best, on_tpu, batch,
+                                        seq, cfg)
         result["baseline_tokens_per_sec"] = round(baseline, 1)
         result["baseline_batch"] = bl_batch
         result["vs_baseline"] = round(fused / baseline, 2)
     except Exception as e:  # keep the primary metric even if the
         result["baseline_error"] = repr(e)[:120]  # baseline OOMs/fails
     try:
-        mha_fused, mha_unfused = _retry(_mha_latencies, on_tpu)
+        with _timed(durations, "mha_fwd_bwd_ms"):
+            mha_fused, mha_unfused = _retry(_mha_latencies, on_tpu)
         result["mha_fused_fwd_bwd_ms"] = round(mha_fused, 2)
         result["mha_unfused_fwd_bwd_ms"] = round(mha_unfused, 2)
     except Exception as e:
         result["mha_error"] = repr(e)[:120]
     try:
-        result["gpt1p3b_tokens_per_sec_per_chip"] = round(
-            _retry(_gpt1p3b_tokens_per_sec, on_tpu), 1)
+        with _timed(durations, "gpt1p3b_tokens_per_sec_per_chip"):
+            result["gpt1p3b_tokens_per_sec_per_chip"] = round(
+                _retry(_gpt1p3b_tokens_per_sec, on_tpu), 1)
     except Exception as e:
         result["gpt1p3b_error"] = repr(e)[:120]
     try:
-        result["bert_seq_per_sec"] = round(
-            _retry(_bert_seq_per_sec, on_tpu), 1)
+        with _timed(durations, "bert_seq_per_sec"):
+            result["bert_seq_per_sec"] = round(
+                _retry(_bert_seq_per_sec, on_tpu), 1)
     except Exception as e:
         result["bert_error"] = repr(e)[:120]
     try:
-        if on_tpu:
-            try:
-                result["resnet50_img_per_sec"] = _run_isolated(
-                    "resnet50_img_per_sec")
-                result["resnet50_isolated"] = True
-            except Exception:
+        with _timed(durations, "resnet50_img_per_sec"):
+            if on_tpu:
+                try:
+                    result["resnet50_img_per_sec"] = _run_isolated(
+                        "resnet50_img_per_sec")
+                    result["resnet50_isolated"] = True
+                except Exception:
+                    result["resnet50_img_per_sec"] = _ONLY[
+                        "resnet50_img_per_sec"](on_tpu)
+                    result["resnet50_isolated"] = False
+            else:
                 result["resnet50_img_per_sec"] = _ONLY[
                     "resnet50_img_per_sec"](on_tpu)
-                result["resnet50_isolated"] = False
-        else:
-            result["resnet50_img_per_sec"] = _ONLY[
-                "resnet50_img_per_sec"](on_tpu)
     except Exception as e:
         result["resnet50_error"] = repr(e)[:120]
     try:
-        result["adam_1b_step_ms"] = round(
-            _retry(_adam_1b_step_ms, on_tpu), 2)
+        with _timed(durations, "adam_1b_step_ms"):
+            result["adam_1b_step_ms"] = round(
+                _retry(_adam_1b_step_ms, on_tpu), 2)
     except Exception as e:
         result["adam_1b_error"] = repr(e)[:120]
     try:
-        lc_ms, lc_tps = _retry(_long_context_32k, on_tpu)
+        with _timed(durations, "long_context_32k"):
+            lc_ms, lc_tps = _retry(_long_context_32k, on_tpu)
         result["long_context_32k_fwd_bwd_ms"] = round(lc_ms, 1)
         result["long_context_32k_tokens_per_sec"] = round(lc_tps, 1)
     except Exception as e:
         result["long_context_error"] = repr(e)[:120]
     try:
-        ok, fails = _kernel_smoke()
+        with _timed(durations, "kernel_smoke"):
+            ok, fails = _kernel_smoke()
         result["kernel_smoke_ok"] = ok
         if fails:
             result["kernel_smoke_failures"] = fails
     except Exception as e:
         result["kernel_smoke_ok"] = False
         result["kernel_smoke_error"] = repr(e)[:120]
+    # schema stamp + per-metric wall clock (ISSUE 2): keeps BENCH_*.json
+    # trajectories comparable as metrics are added across rounds
+    result["monitor_schema_version"] = SCHEMA_VERSION
+    result["metric_durations_s"] = durations
     print(json.dumps(result))
 
 

@@ -44,7 +44,33 @@ def force_cpu_devices_from_argv():
                  f"got {raw!r}")
     if n <= 0:
         return
+    # jax 0.4.x has no jax_num_cpu_devices option — there the device
+    # count comes from XLA_FLAGS, which must be in the environment
+    # BEFORE the first jax import (the same dual path as
+    # tests/conftest.py).  Set it unconditionally: on newer jax it is
+    # harmlessly redundant with the config update below.
+    if "jax" not in sys.modules:
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count={n}"
+            ).strip()
     import jax
 
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", n)
+    try:
+        jax.config.update("jax_num_cpu_devices", n)
+    except AttributeError:
+        # jax 0.4.x: the XLA_FLAGS set above provides the device count
+        # — unless jax was already imported (flags too late) or the
+        # environment pre-set its own count (respected: it may be the
+        # caller's, e.g. the 8-way test harness satisfying a request
+        # for 1).  Fail loudly only when FEWER devices than requested
+        # are available — silently running under-parallel is the bug.
+        if jax.device_count() < n:
+            sys.exit(
+                f"--force-cpu-devices {n}: this jax has no "
+                f"jax_num_cpu_devices option and the XLA_FLAGS fallback "
+                f"could not apply (jax already imported? devices="
+                f"{jax.device_count()})")

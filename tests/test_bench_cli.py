@@ -140,3 +140,21 @@ def test_kernel_smoke_reports_ok_and_failures(monkeypatch):
     monkeypatch.setattr(sp, "run", fake_ok)
     ok, fails = bench._kernel_smoke()
     assert ok is True and fails == []
+
+
+def test_timed_records_duration_even_on_error():
+    """Per-metric wall clock (ISSUE 2 satellite): _timed stamps the
+    durations dict on success AND on the error path (a 15-min OOM
+    spiral must be visible in the BENCH trajectory), and the JSON gains
+    monitor_schema_version for cross-round comparability."""
+    durations = {}
+    with bench._timed(durations, "ok"):
+        pass
+    with pytest.raises(RuntimeError):
+        with bench._timed(durations, "boom"):
+            raise RuntimeError("x")
+    assert set(durations) == {"ok", "boom"}
+    assert all(isinstance(v, float) and v >= 0 for v in durations.values())
+
+    from apex_tpu import monitor
+    assert isinstance(monitor.SCHEMA_VERSION, int)

@@ -51,3 +51,28 @@ def test_long_context_training_runs():
     losses = [float(ln.split("loss")[1].split()[0]) for ln in lines]
     assert all(l == l and abs(l) < 1e9 for l in losses), losses
     assert losses[1] < losses[0], losses
+
+
+def test_train_with_monitor_runs(tmp_path):
+    """ISSUE 2 tier-1 gate: the telemetry demo trains 3 steps on CPU
+    and every metrics JSONL line validates against the monitor schema
+    (required fields, finite values, monotonic steps)."""
+    import json
+
+    from apex_tpu import monitor
+
+    jsonl = tmp_path / "metrics.jsonl"
+    r = _run("train_with_monitor.py", "--steps", "3",
+             "--jsonl", str(jsonl), "--force-cpu-devices", "1")
+    assert r.returncode == 0, r.stderr[-2000:]
+    records = [json.loads(ln) for ln in jsonl.read_text().splitlines()]
+    # the stream interleaves full step records with ScalarWriter timer
+    # tags; the schema governs the step records
+    step_records = [rec for rec in records if "loss" in rec]
+    assert len(step_records) == 3, records
+    monitor.validate_records(step_records)  # raises on NaN/non-monotonic
+    for rec in step_records:
+        assert rec["tokens_per_sec"] > 0
+        assert rec["step_time_ms"] > 0
+    assert any("train-step-time" in rec for rec in records), \
+        "Timers.write scalars missing from the JSONL stream"

@@ -1,0 +1,131 @@
+"""Satellite coverage (ISSUE 2): utils/log_util.py (env verbosity,
+handler idempotence) and the fixed utils/timers.py blocking semantics +
+unknown-name hardening."""
+
+import logging
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from apex_tpu.utils import log_util
+from apex_tpu.utils.timers import Timers, _Timer
+
+
+# ------------------------------ log_util ------------------------------
+
+def test_set_logging_level_idempotent():
+    """Calling set_logging_level twice must not duplicate handlers (the
+    rank-info formatter would double every line)."""
+    logger = logging.getLogger("apex_tpu")
+    before = list(logger.handlers)
+    try:
+        log_util.set_logging_level("DEBUG")
+        n1 = len(logger.handlers)
+        log_util.set_logging_level("INFO")
+        assert len(logger.handlers) == n1
+        assert logger.level == logging.INFO
+    finally:
+        logger.handlers[:] = before
+
+
+def test_rank_info_formatter_formats_without_mesh():
+    from apex_tpu import RankInfoFormatter
+
+    f = RankInfoFormatter("[%(rank_info)s] %(message)s")
+    rec = logging.LogRecord("apex_tpu.x", logging.INFO, __file__, 1,
+                            "hello", (), None)
+    out = f.format(rec)
+    assert out.endswith("hello") and "[" in out
+    # idempotent: formatting the same record twice is stable
+    assert f.format(rec) == out
+
+
+def test_env_var_verbosity_applies_at_import():
+    """APEX_TPU_VERBOSITY in the environment sets the package logger
+    level at first import (checked in a fresh interpreter)."""
+    code = ("import logging, apex_tpu.utils.log_util; "
+            "import sys; "
+            "sys.exit(0 if logging.getLogger('apex_tpu').level == "
+            "logging.DEBUG else 1)")
+    r = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        timeout=240,
+        env={"APEX_TPU_VERBOSITY": "DEBUG", "JAX_PLATFORMS": "cpu",
+             "PATH": "/usr/local/bin:/usr/bin:/bin",
+             "PYTHONPATH": str(__import__("pathlib").Path(
+                 __file__).resolve().parent.parent)})
+    assert r.returncode == 0, r.stderr[-2000:]
+
+
+def test_get_transformer_logger_strips_extension():
+    lg = log_util.get_transformer_logger("module.py")
+    assert lg.name == "module"
+
+
+# ------------------------------ timers ------------------------------
+
+def test_timer_block_calls_block_until_ready(monkeypatch):
+    """The ISSUE 2 satellite fix: stop(block=True) must DRAIN execution
+    (block_until_ready on live arrays), not merely iterate over them —
+    otherwise 'blocked' timings measure dispatch."""
+    calls = []
+
+    class FakeArray:
+        def block_until_ready(self):
+            calls.append("blocked")
+
+    monkeypatch.setattr(jax, "live_arrays",
+                        lambda: [FakeArray(), FakeArray()])
+    t = _Timer("x")
+    t.start()
+    t.stop(block=True)
+    assert calls == ["blocked", "blocked"]
+
+
+def test_timer_block_wall_clock_covers_execution():
+    """End-to-end: a blocked stop on a dispatched computation reports a
+    nonzero elapsed time and leaves the timer reusable."""
+    t = Timers()
+    t("step").start()
+    x = jnp.ones((256, 256))
+    y = (x @ x).sum()
+    t("step").stop(block=True)
+    assert y.block_until_ready() is not None
+    assert t("step").elapsed(reset=True) > 0.0
+    t("step").start()  # restartable after elapsed(reset=True)
+    t("step").stop()
+
+
+def test_timers_unknown_name_raises_clear_keyerror():
+    t = Timers()
+    t("fwd").start()
+    t("fwd").stop()
+    with pytest.raises(KeyError, match=r"unknown timer 'bwd'.*fwd"):
+        t.log(["bwd"])
+    with pytest.raises(KeyError, match="unknown timer"):
+        t.write(["nope"], writer=None, iteration=0)
+    # registry unpolluted by the failed lookups
+    assert sorted(t.timers) == ["fwd"]
+    with pytest.raises(KeyError, match=r"\(none\)"):
+        Timers().log(["anything"])
+
+
+def test_timers_log_and_write_still_work():
+    class W:
+        def __init__(self):
+            self.rows = []
+
+        def add_scalar(self, tag, value, step):
+            self.rows.append((tag, value, step))
+
+    t = Timers()
+    t("fwd").start()
+    t("fwd").stop()
+    s = t.log(["fwd"], reset=False)
+    assert "fwd" in s and "time (ms)" in s
+    w = W()
+    t.write(["fwd"], w, iteration=3)
+    assert w.rows and w.rows[0][0] == "fwd-time" and w.rows[0][2] == 3

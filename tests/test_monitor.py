@@ -1,0 +1,385 @@
+"""apex_tpu.monitor tests (ISSUE 2): the metrics pytree, sinks/logger
+schema, FLOP accounting, profiler capture, and — the acceptance
+criterion — that enabling `metrics=` in the hot paths changes NO
+training numerics (bitwise-equal params)."""
+
+import json
+import math
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu import amp, monitor
+from apex_tpu.optimizers.fused_adam import FusedAdam
+from apex_tpu.parallel import ddp
+from apex_tpu.parallel import mesh as M
+
+
+# ------------------------------ metrics pytree ------------------------------
+
+def test_update_metrics_accumulates():
+    m = monitor.init_metrics()
+    g = {"w": jnp.full((4,), 3.0), "b": jnp.full((9,), 4.0)}
+    m = monitor.update_metrics(m, loss=2.5, grads=g, tokens=128,
+                               loss_scale=8.0,
+                               found_inf=jnp.zeros((), bool))
+    # sqrt(4*9 + 9*16) = sqrt(180)
+    np.testing.assert_allclose(float(m.grad_norm), math.sqrt(180), rtol=1e-6)
+    assert (int(m.step), float(m.loss), float(m.loss_scale)) == (1, 2.5, 8.0)
+    assert int(m.overflow_count) == 0
+    m = monitor.update_metrics(m, loss=2.0, grads=g, tokens=128,
+                               found_inf=jnp.ones((), bool))
+    assert int(m.step) == 2
+    assert int(m.overflow_count) == 1 and int(m.skipped_steps) == 1
+    assert float(m.tokens_seen) == 256.0
+
+
+def test_update_metrics_scaled_grads_and_flat_norms():
+    m = monitor.init_metrics()
+    p0 = jnp.asarray([3.0, 4.0])
+    p1 = jnp.asarray([3.0, 4.0 + 2.0])
+    m = monitor.update_metrics(m, grads={"w": jnp.full((4,), 8.0)},
+                               inv_scale=0.25, params_flat=p0,
+                               new_params_flat=p1)
+    np.testing.assert_allclose(float(m.grad_norm), 2.0 * 2.0)  # 8*0.25 * 2
+    np.testing.assert_allclose(float(m.param_norm), 5.0)
+    np.testing.assert_allclose(float(m.update_norm), 2.0)
+
+
+def test_infer_tokens_per_step():
+    tok = jnp.zeros((4, 16), jnp.int32)
+    img = jnp.zeros((4, 8, 8, 3), jnp.float32)
+    assert monitor.infer_tokens_per_step((tok, tok)) == 64
+    assert monitor.infer_tokens_per_step((img, tok)) == 4
+    # microbatch-stacked (m, mb, ...) variants
+    assert monitor.infer_tokens_per_step(
+        jnp.zeros((2, 4, 16), jnp.int32), microbatch_dims=1) == 128
+    assert monitor.infer_tokens_per_step(
+        jnp.zeros((2, 4, 8, 8, 3)), microbatch_dims=1) == 8
+    assert monitor.infer_tokens_per_step({}) == 0
+
+
+# ------------------------------ sinks + logger ------------------------------
+
+def _fake_metrics(step=1, tokens=256.0):
+    m = monitor.init_metrics()
+    return m._replace(step=jnp.asarray(step, jnp.int32),
+                      loss=jnp.asarray(1.25, jnp.float32),
+                      grad_norm=jnp.asarray(0.5, jnp.float32),
+                      tokens_seen=jnp.asarray(tokens, jnp.float32))
+
+
+def test_logger_jsonl_schema_roundtrip(tmp_path):
+    path = tmp_path / "m.jsonl"
+    logger = monitor.MetricsLogger([monitor.JSONLSink(path)],
+                                   flops_per_step=1e9)
+    for s in (1, 2, 3):
+        logger.log_step(_fake_metrics(step=s, tokens=256.0 * s))
+    logger.close()
+    records = [json.loads(l) for l in path.read_text().splitlines()]
+    assert len(records) == 3
+    monitor.validate_records(records)
+    assert records[0]["monitor_schema_version"] == monitor.SCHEMA_VERSION
+    assert records[1]["tokens_per_sec"] > 0
+    assert records[1]["mfu"] > 0
+    assert records[1]["step_time_ms"] > 0
+
+
+def test_reset_timer_resyncs_baselines():
+    """After counted-but-unlogged warmup steps, reset_timer(metrics)
+    must resync the step/token baselines — otherwise the first window
+    divides by the warmup's extra steps (review finding: 3x-inflated
+    tokens_per_sec in the demo)."""
+    logger = monitor.MetricsLogger([])
+    warm = _fake_metrics(step=2, tokens=512.0)  # 2 warmup steps counted
+    logger.reset_timer(warm)
+    rec = logger.log_step(_fake_metrics(step=3, tokens=768.0))
+    # window covers exactly ONE step / 256 tokens
+    assert rec["step_time_ms"] * 1e-3 == pytest.approx(
+        256.0 / rec["tokens_per_sec"], rel=1e-6)
+
+
+def test_jsonl_sink_truncates_by_default(tmp_path):
+    """A re-run against the default path must not append onto the old
+    trajectory (appended steps restart at 1 → validate_records would
+    reject the file)."""
+    path = tmp_path / "m.jsonl"
+    for _ in range(2):
+        logger = monitor.MetricsLogger([monitor.JSONLSink(path)])
+        logger.log_step(_fake_metrics(step=1))
+        logger.close()
+    records = [json.loads(l) for l in path.read_text().splitlines()]
+    assert len(records) == 1
+    monitor.validate_records(records)
+    with pytest.raises(ValueError, match="mode"):
+        monitor.JSONLSink(path, mode="x")
+
+
+def test_validate_record_rejects_bad_records():
+    logger = monitor.MetricsLogger([])
+    rec = logger.log_step(_fake_metrics())
+    with pytest.raises(ValueError, match="missing field"):
+        monitor.validate_record({k: v for k, v in rec.items()
+                                 if k != "grad_norm"})
+    bad = dict(rec, loss=float("nan"))
+    with pytest.raises(ValueError, match="non-finite"):
+        monitor.validate_record(bad)
+    with pytest.raises(ValueError, match="non-monotonic"):
+        monitor.validate_records([rec, rec])
+    with pytest.raises(ValueError, match="monitor_schema_version"):
+        monitor.validate_record(dict(rec, monitor_schema_version=999))
+
+
+def test_console_sink_formats_line():
+    lines = []
+    sink = monitor.ConsoleSink(print_fn=lines.append)
+    monitor.MetricsLogger([sink]).log_step(_fake_metrics())
+    assert len(lines) == 1 and "loss 1.2500" in lines[0]
+    # step-only records (ScalarWriter tags) stay silent
+    sink.write({"step": 3, "fwd-time": 0.1})
+    assert len(lines) == 1
+
+
+def test_scalar_writer_is_summary_writer_compatible(tmp_path):
+    """Timers.write targets anything with add_scalar — including the
+    monitor stack (the ISSUE 2 adapter requirement)."""
+    from apex_tpu.utils.timers import Timers
+
+    path = tmp_path / "t.jsonl"
+    writer = monitor.ScalarWriter(monitor.JSONLSink(path))
+    t = Timers()
+    t("fwd").start()
+    t("fwd").stop()
+    t.write(["fwd"], writer, iteration=7)
+    writer.close()
+    (rec,) = [json.loads(l) for l in path.read_text().splitlines()]
+    assert rec["step"] == 7 and rec["fwd-time"] >= 0.0
+
+
+def test_summary_writer_sink_forwards_and_validates():
+    calls = []
+
+    class W:
+        def add_scalar(self, tag, value, step):
+            calls.append((tag, value, step))
+
+    sink = monitor.SummaryWriterSink(W())
+    sink.write({"step": 4, "loss": 1.0, "note": "str ignored"})
+    assert calls == [("train/loss", 1.0, 4)]
+    with pytest.raises(TypeError, match="add_scalar"):
+        monitor.SummaryWriterSink(object())
+
+
+# ------------------------------ flops ------------------------------
+
+def test_transformer_flops_matches_anatomy_formula():
+    """Same numbers as scripts/gpt_anatomy.py's per-sublayer accounting
+    (attn proj + full-square sdpa + mlp, x3 fwd+bwd, + head)."""
+    b, s, h, l, heads, v = 2, 64, 32, 2, 4, 128
+    d = h // heads
+    attn = (2 * b * s * h * 4 * h + 2 * b * heads * s * s * d * 2) * 3
+    mlp = 2 * b * s * h * 8 * h * 3
+    head = 2 * b * s * h * v * 3
+    want = (attn + mlp) * l + head
+    got = monitor.transformer_step_flops(
+        hidden=h, num_layers=l, num_heads=heads, vocab_size=v, batch=b,
+        seq=s)
+    assert got == want
+
+    from apex_tpu.models.gpt import GPTConfig
+    cfg = GPTConfig(vocab_size=v, seq_len=s, hidden=h, num_layers=l,
+                    num_heads=heads)
+    assert monitor.gpt_step_flops(cfg, batch=b) == want
+
+
+def test_mfu():
+    assert monitor.mfu(1e12, 1.0, peak_flops=2e12) == 0.5
+    assert monitor.mfu(1e12, 0.0) == 0.0
+
+
+# ------------------------------ profiler capture ------------------------------
+
+def test_profile_capture_window(tmp_path):
+    logdir = str(tmp_path / "trace")
+    cap = monitor.profile_capture(range(1, 3), logdir=logdir)
+    seen_active = []
+    for i in range(5):
+        with cap.step(i):
+            seen_active.append(cap.active)
+            jnp.ones((4, 4)).sum().block_until_ready()
+    assert seen_active == [False, True, True, False, False]
+    assert not cap.active
+    files = [f for _, _, fs in os.walk(logdir) for f in fs]
+    assert files, "profiler trace produced no files"
+    cap.close()  # idempotent
+
+
+def test_profile_capture_close_is_safety_net(tmp_path):
+    cap = monitor.profile_capture([0, 1], logdir=str(tmp_path / "t"))
+    with cap.step(0):
+        pass
+    assert cap.active  # window still open (last step not reached)
+    cap.close()
+    assert not cap.active
+
+
+# ------------------------------ hot-path wiring ------------------------------
+
+def _linear_problem():
+    X = jnp.asarray(np.random.default_rng(0).normal(size=(32, 4)),
+                    jnp.float32)
+    Y = X @ jnp.asarray([[1.0], [-2.0], [0.5], [3.0]])
+
+    def loss_fn(params, batch):
+        x, y = batch
+        return jnp.mean((x @ params["w"] - y) ** 2)
+
+    return loss_fn, {"w": jnp.zeros((4, 1))}, (X, Y)
+
+
+def _train(mesh, metrics_on, steps=5):
+    loss_fn, params0, batch = _linear_problem()
+    amp_state = amp.initialize(opt_level="O0", loss_scale="dynamic")
+    scaler = amp_state.loss_scalers[0]
+    opt = FusedAdam(lr=0.05, use_pallas=False)
+    state = opt.init(params0)
+    step = ddp.make_train_step(loss_fn, opt, mesh, amp_state=amp_state,
+                               batch_spec=(P("dp"), P("dp")),
+                               metrics=True if metrics_on else None)
+    metrics = monitor.init_metrics()
+    loss = None
+    for _ in range(steps):
+        if metrics_on:
+            state, scaler, loss, metrics = step(state, scaler, batch,
+                                                metrics)
+        else:
+            state, scaler, loss = step(state, scaler, batch)
+    return state, float(loss), metrics
+
+
+def test_make_train_step_metrics_bitwise_identical_numerics():
+    """ISSUE 2 acceptance: metrics= must not perturb training — params
+    after 5 steps are BITWISE equal with metrics on vs off."""
+    mesh = M.initialize_model_parallel()
+    state_off, loss_off, _ = _train(mesh, metrics_on=False)
+    state_on, loss_on, _ = _train(mesh, metrics_on=True)
+    a = np.asarray(jax.device_get(state_off.params))
+    b = np.asarray(jax.device_get(state_on.params))
+    assert a.tobytes() == b.tobytes(), "metrics= changed training numerics"
+    assert loss_off == loss_on
+
+
+def test_make_train_step_metrics_values():
+    mesh = M.initialize_model_parallel()
+    _, loss, m = _train(mesh, metrics_on=True, steps=3)
+    assert int(m.step) == 3
+    # m.loss is the GLOBAL dp-mean; the step's loss output is one
+    # shard's local value — same ballpark, not equal (see below test)
+    assert math.isfinite(float(m.loss)) and float(m.loss) > 0
+    assert float(m.grad_norm) > 0 and math.isfinite(float(m.grad_norm))
+    assert float(m.param_norm) > 0
+    assert float(m.update_norm) > 0
+    assert float(m.loss_scale) == 65536.0
+    assert int(m.overflow_count) == 0
+    # float X (samples-counting heuristic): 32 global samples x 3 steps
+    assert float(m.tokens_seen) == 96.0
+
+
+def test_metrics_loss_is_global_dp_mean():
+    """The recorded loss must be the FULL-batch mean, not one shard's
+    local loss (the raw loss output's P() out-spec takes shard 0's)."""
+    mesh = M.initialize_model_parallel()
+    loss_fn, params0, (X, Y) = _linear_problem()
+    opt = FusedAdam(lr=0.05, use_pallas=False)
+    state = opt.init(params0)
+    step = ddp.make_train_step(loss_fn, opt, mesh,
+                               batch_spec=(P("dp"), P("dp")),
+                               metrics=True)
+    m = monitor.init_metrics()
+    _, _, _, m = step(state, None, (X, Y), m)
+    # step 1 runs with params0 = zeros: full-batch MSE = mean(Y^2)
+    np.testing.assert_allclose(float(m.loss),
+                               float(jnp.mean(Y ** 2)), rtol=1e-5)
+
+
+def test_forward_backward_no_pipelining_metrics():
+    from apex_tpu.transformer.pipeline_parallel.schedules import (
+        forward_backward_no_pipelining)
+
+    w = {"w": jnp.asarray([[2.0], [1.0]])}
+    batch = jnp.asarray(np.random.default_rng(1).normal(size=(4, 8, 2)),
+                        jnp.float32)
+
+    def fwd(p, mb):
+        return jnp.mean((mb @ p["w"]) ** 2)
+
+    # legacy shape untouched
+    loss0, grads0 = forward_backward_no_pipelining(
+        fwd, batch, w, num_microbatches=4)
+    m0 = monitor.init_metrics()
+    loss, grads, m = jax.jit(
+        lambda b, mm: forward_backward_no_pipelining(
+            fwd, b, w, num_microbatches=4, metrics=mm))(batch, m0)
+    np.testing.assert_allclose(float(loss), float(loss0), rtol=1e-6)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-6), grads0, grads)
+    assert int(m.step) == 1
+    np.testing.assert_allclose(float(m.loss), float(loss0), rtol=1e-6)
+    np.testing.assert_allclose(float(m.grad_norm),
+                               float(monitor.global_norm(grads0)),
+                               rtol=1e-6)
+    assert float(m.tokens_seen) == 32.0  # 4 microbatches x 8 samples
+    # main_grad_dtype path threads metrics too
+    _, _, m2 = forward_backward_no_pipelining(
+        fwd, batch, w, num_microbatches=4, metrics=m,
+        main_grad_dtype=jnp.float32)
+    assert int(m2.step) == 2 and float(m2.tokens_seen) == 64.0
+
+
+def test_fp16_optimizer_metrics_overflow_accounting():
+    from apex_tpu.amp.fp16_optimizer import FP16_Optimizer
+
+    params = {"w": jnp.ones((4,))}
+    fp16 = FP16_Optimizer(FusedAdam(lr=0.1, use_pallas=False),
+                          dynamic_loss_scale=True)
+    state = fp16.init(params)
+    m = monitor.init_metrics()
+
+    scale0 = fp16.loss_scale
+    good = {"w": jnp.full((4,), 0.5) * scale0}
+    params1, state, m = fp16.step(state, good, metrics=m)
+    assert int(m.overflow_count) == 0
+    np.testing.assert_allclose(float(m.grad_norm), 1.0, rtol=1e-5)
+    np.testing.assert_allclose(float(m.loss_scale), scale0)
+    assert float(m.update_norm) > 0
+
+    bad = {"w": jnp.asarray([1.0, jnp.inf, 1.0, 1.0])}
+    params2, state, m = fp16.step(state, bad, metrics=m)
+    assert int(m.overflow_count) == 1 and int(m.skipped_steps) == 1
+    # the skipped step must not move params
+    np.testing.assert_array_equal(np.asarray(params1["w"]),
+                                  np.asarray(params2["w"]))
+    # grad_norm records the PRE-clip norm (a clipped norm pins at the
+    # threshold and can never show the spike)
+    scale1 = fp16.loss_scale
+    big = {"w": jnp.full((4,), 100.0) * scale1}
+    _, state, m = fp16.step(state, big, max_grad_norm=1.0, metrics=m)
+    np.testing.assert_allclose(float(m.grad_norm), 200.0, rtol=1e-4)
+
+    # metrics_count_step=False: fields update, step doesn't advance
+    # (for composition with a loss-side hook in the same iteration)
+    before = int(m.step)
+    good2 = {"w": jnp.full((4,), 0.5) * fp16.loss_scale}
+    _, state, m = fp16.step(state, good2, metrics=m,
+                            metrics_count_step=False)
+    assert int(m.step) == before
+    np.testing.assert_allclose(float(m.grad_norm), 1.0, rtol=1e-5)
+
+    # legacy 2-tuple return preserved without metrics
+    out = fp16.step(state, good)
+    assert len(out) == 2
