@@ -52,6 +52,11 @@ class BertConfig:
     # instead of the dense FusedScaleMaskSoftmax path: no S^2 score
     # matrix, so BERT trains at seq 4k+ on one chip (VERDICT r1 #3)
     use_flash_attention: bool = False
+    # explicit flash kernel-shape overrides; None → autotuner lookup
+    # then heuristics (same contract as GPTConfig.attn_*)
+    attn_block_q: Any = None
+    attn_block_k: Any = None
+    attn_heads_per_step: Any = None
     axis_name: str = TP_AXIS
 
     @property
@@ -151,7 +156,11 @@ class Bert:
             seg = jnp.logical_not(pad_mask).astype(jnp.int32)
             ctx = flash_attention(q, k, v,
                                   softmax_scale=1.0 / math.sqrt(c.head_dim),
-                                  segment_ids=seg).astype(x.dtype)
+                                  segment_ids=seg,
+                                  block_q=c.attn_block_q,
+                                  block_k=c.attn_block_k,
+                                  heads_per_step=c.attn_heads_per_step
+                                  ).astype(x.dtype)
         else:
             scores = jnp.einsum("bnsh,bnth->bnst", q, k,
                                 preferred_element_type=jnp.float32

@@ -79,6 +79,14 @@ class GPTConfig:
     logits_dtype: Any = None
     sequence_parallel: bool = False
     use_flash_attention: bool = False
+    # Explicit flash kernel-shape overrides for A/B sweeps.  None (the
+    # default) lets the flash kernel consult the apex_tpu.tune cache at
+    # trace time for a config tuned at this exact (shape, dtype,
+    # device-kind) key, falling back to the built-in heuristics on a
+    # miss — so an untuned machine runs exactly the pre-tuner kernels.
+    attn_block_q: Any = None
+    attn_block_k: Any = None
+    attn_heads_per_step: Any = None
     remat: bool = False            # activation checkpointing per block
     # What the per-block checkpoint may keep (≡ the reference's partial /
     # selective activation checkpointing, fwd_bwd_pipelining_without_
@@ -209,7 +217,10 @@ class GPT:
             ctx = flash_attention(q, k, v, causal=True,
                                   softmax_scale=1.0 / math.sqrt(c.head_dim),
                                   dropout_rate=rate,
-                                  dropout_key=key if rate > 0 else None)
+                                  dropout_key=key if rate > 0 else None,
+                                  block_q=c.attn_block_q,
+                                  block_k=c.attn_block_k,
+                                  heads_per_step=c.attn_heads_per_step)
         else:
             scores = jnp.einsum("bnsh,bnth->bnst", q, k,
                                 preferred_element_type=jnp.float32

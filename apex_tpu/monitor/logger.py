@@ -99,10 +99,16 @@ class MetricsLogger:
 
     def __init__(self, sinks: Sequence[MetricSink], *,
                  flops_per_step: Optional[float] = None,
-                 peak_flops: float = flops_lib.V5E_BF16_PEAK):
+                 peak_flops: float = flops_lib.V5E_BF16_PEAK,
+                 log_tuner: bool = True):
         self.sinks = list(sinks)
         self.flops_per_step = flops_per_step
         self.peak_flops = peak_flops
+        # stamp the active kernel-autotuner config fingerprint into
+        # every record (ISSUE 3): two trajectories with different
+        # fingerprints ran different tuned kernels.  Extra keys are
+        # schema-legal (validate_record allows them).
+        self.log_tuner = log_tuner
         self.writer = ScalarWriter(*self.sinks)
         self._last_t = time.perf_counter()
         self._last_step = 0
@@ -152,6 +158,15 @@ class MetricsLogger:
                     if self.flops_per_step else 0.0),
             "overflowed_this_window": overflows > self._last_overflows,
         }
+        if self.log_tuner:
+            try:
+                from apex_tpu import tune
+                t = tune.stats()
+                record["tuner_fingerprint"] = t["fingerprint"]
+                record["tuner_hits"] = t["hits"]
+                record["tuner_misses"] = t["misses"]
+            except Exception:  # pragma: no cover — never break logging
+                pass
         if extra:
             record.update(extra)
         for s in self.sinks:

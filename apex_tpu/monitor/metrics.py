@@ -111,6 +111,7 @@ def infer_tokens_per_step(batch, microbatch_dims: int = 0) -> int:
 
 def update_metrics(state: MetricsState, *, loss=None, grads=None,
                    inv_scale=1.0, params_flat=None, new_params_flat=None,
+                   param_norm=None, update_norm=None,
                    loss_scale=None, found_inf=None,
                    tokens: int = 0,
                    count_step: bool = True) -> MetricsState:
@@ -130,6 +131,10 @@ def update_metrics(state: MetricsState, *, loss=None, grads=None,
     new_params_flat are the optimizer's flat master buffers before and
     after the update (`FusedAdamState.params` etc.) — the update norm is
     computed as their difference, no per-leaf tree needed.
+    param_norm / update_norm pass PRECOMPUTED norms instead (they win
+    over the flat buffers): the ZeRO-2 path in ddp.make_train_step uses
+    them because its state buffers are rank shards whose global norms
+    need a psum the caller owns.
     """
     if not isinstance(state, MetricsState):
         raise TypeError(
@@ -144,11 +149,15 @@ def update_metrics(state: MetricsState, *, loss=None, grads=None,
         gn = global_norm(grads) * jnp.asarray(inv_scale, jnp.float32)
     else:
         gn = state.grad_norm
-    if params_flat is not None:
+    if param_norm is not None:
+        pn = jnp.asarray(param_norm, jnp.float32).reshape(())
+    elif params_flat is not None:
         pn = jnp.linalg.norm(params_flat.astype(jnp.float32))
     else:
         pn = state.param_norm
-    if new_params_flat is not None and params_flat is not None:
+    if update_norm is not None:
+        un = jnp.asarray(update_norm, jnp.float32).reshape(())
+    elif new_params_flat is not None and params_flat is not None:
         un = jnp.linalg.norm(
             (new_params_flat.astype(jnp.float32)
              - params_flat.astype(jnp.float32)))

@@ -68,6 +68,26 @@ def row_block(rows: int, hidden: int, bytes_per_elt: int = 4,
     return round_up(b, align) if b % align else b
 
 
+def tuned_row_block(op: str, rows: int, hidden: int, **kw) -> int:
+    """row_block with an autotuner override: consult apex_tpu.tune for
+    (op, pow2-bucketed rows, hidden) on this device kind; a hit whose
+    block_rows is a sane sublane multiple wins, anything else falls
+    back to the deterministic heuristic.  Trace-time host-side lookup
+    only — no device work (tune package docstring)."""
+    base = row_block(rows, hidden, **kw)
+    try:
+        from apex_tpu import tune
+        cfg = tune.tuned(op, dict(rows=tune.pow2_bucket(rows),
+                                  hidden=hidden))
+    except Exception:  # pragma: no cover — tuner must never break ops
+        return base
+    if cfg:
+        blk = cfg.get("block_rows")
+        if (isinstance(blk, int) and 8 <= blk <= 4096 and blk % 8 == 0):
+            return blk
+    return base
+
+
 def dropout(key, rate: float, x):
     """Inverted-bernoulli dropout: zero with probability `rate`, scale
     survivors by 1/(1-rate).  The ONE implementation shared by the dense

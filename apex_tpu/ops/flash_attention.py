@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import functools
 import math
+import warnings
 from typing import Optional
 
 import jax
@@ -38,6 +39,10 @@ _NEG_INF = -1e30
 # fused single-pass backward cap: full-(sk, d) dk/dv scratch must fit
 # VMEM (tests monkeypatch this to force the two-kernel path at small sizes)
 _FUSED_BWD_CAP = 256 * 1024
+# head-packed fused backward: TOTAL (hp, sk, d) scratch cap — two fp32
+# scratches at this size are 4 MB of VMEM; beyond it the backward drops
+# to hp=1 (fused or two-kernel as before)
+_FUSED_BWD_CAP_PACKED = 512 * 1024
 
 
 def _causal_dispatch(step_fn, j, t, bq, bk, causal):
@@ -142,26 +147,33 @@ def _extras_arrays(b, h, sq, sk, nq, bq, nk, bk, bias, q_seg, kv_seg,
 
 
 def _extras_specs(h, nq, bq, nk, bk, bias_kind, nb, nh, has_seg, *,
-                  jt_from_args):
+                  jt_from_args, hp=1):
     """BlockSpecs for (bias_t, q_seg, kv_seg).  `jt_from_args` maps the
-    grid args after i to (j, t) — grids differ in block order."""
+    grid args after i to (j, t) — grids differ in block order.
+
+    With head packing (hp > 1, hp | h) grid axis 0 indexes GROUPS of hp
+    consecutive heads: i = batch * (h/hp) + head_group, so the batch
+    index becomes i // (h/hp) and a per-head ("full"/"sk" with nh > 1)
+    bias rides as an hp-tall head block.  At hp == 1 every map below is
+    exactly the unpacked one."""
+    hg = h // hp   # head groups per batch (grid-axis-0 stride)
     if bias_kind == "full":
         def bias_idx(i, *rest):
             j, t = jt_from_args(*rest)
-            return (i // h if nb > 1 else 0,
-                    i % h if nh > 1 else 0, t, j)
-        bspec = pl.BlockSpec((1, 1, bk, bq), bias_idx)
+            return (i // hg if nb > 1 else 0,
+                    i % hg if nh > 1 else 0, t, j)
+        bspec = pl.BlockSpec((1, hp if nh > 1 else 1, bk, bq), bias_idx)
     elif bias_kind == "sk":
         def bias_idx(i, *rest):
             j, t = jt_from_args(*rest)
-            return (i // h if nb > 1 else 0,
-                    i % h if nh > 1 else 0, 0, t)
-        bspec = pl.BlockSpec((1, 1, 1, bk), bias_idx)
+            return (i // hg if nb > 1 else 0,
+                    i % hg if nh > 1 else 0, 0, t)
+        bspec = pl.BlockSpec((1, hp if nh > 1 else 1, 1, bk), bias_idx)
     else:
         bspec = pl.BlockSpec((1, 1, 1, 1), lambda i, *_: (0, 0, 0, 0))
     if has_seg:
-        qspec = pl.BlockSpec((1, nq, bq), lambda i, *_: (i // h, 0, 0))
-        kspec = pl.BlockSpec((1, nk, bk), lambda i, *_: (i // h, 0, 0))
+        qspec = pl.BlockSpec((1, nq, bq), lambda i, *_: (i // hg, 0, 0))
+        kspec = pl.BlockSpec((1, nk, bk), lambda i, *_: (i // hg, 0, 0))
     else:
         qspec = pl.BlockSpec((1, 1, 1), lambda i, *_: (0, 0, 0))
         kspec = pl.BlockSpec((1, 1, 1), lambda i, *_: (0, 0, 0))
@@ -329,6 +341,105 @@ def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, qseg_ref, kseg_ref,
         o_ref[0] = (acc_scr[...] / l).T.astype(o_ref.dtype)
         # lse rides as (1, nq, bq) per-head block; write q-block row j
         lse_ref[0, j] = (m_scr[...] + jnp.log(l)).reshape(bq)
+
+
+# ----------------------- head-packed forward kernel -------------------------
+#
+# d=64 heads half-fill the 128-deep MXU contraction port, and the
+# per-step softmax/rescale epilogue runs on (1, bq) stat rows that
+# occupy one sublane of an 8-sublane fp32 vreg.  Packing hp heads per
+# grid step (grid axis 0 over head GROUPS) attacks both overheads: the
+# K/V/Q DMAs move hp-head slabs, the grid runs 1/hp the steps, and the
+# online-softmax statistics become (hp, bq) blocks whose max/exp/
+# rescale chains fill the vregs across heads — one shared epilogue for
+# the whole group.  The per-head matmuls stay separate (a d=64
+# contraction is a hardware fact no packing changes — docs/PERF.md
+# roofline scores against the shape-achievable mix), executed as a
+# static unrolled loop so numerics are bit-identical to the unpacked
+# kernel per head.
+
+
+def _mask_bias_packed(st, j, t, bq, bk, hp, causal_masked, bias_kind,
+                      bias_ref, bias_per_head, has_seg, qseg_ref,
+                      kseg_ref):
+    """_mask_bias over an (hp, bk, bq) stacked score block.  Bias blocks
+    are (1, hp, bk, bq) when per-head (nh > 1) else (1, 1, bk, bq)
+    broadcast; segment ids and the causal mask depend only on (j, t) so
+    one (bk, bq) mask broadcasts across the packed heads."""
+    if bias_kind == "full":
+        st = st + bias_ref[0]                       # (hp|1, bk, bq)
+    elif bias_kind == "sk":
+        nh_blk = hp if bias_per_head else 1
+        st = st + bias_ref[0, :, 0].reshape(nh_blk, bk, 1)
+    if has_seg:
+        qs = qseg_ref[0, j]                         # (bq,) lanes
+        ks = kseg_ref[0, t].reshape(1, bk, 1)
+        st = jnp.where(ks != qs, _NEG_INF, st)
+    if causal_masked:
+        krow = t * bk + lax.broadcasted_iota(jnp.int32, (1, bk, bq), 1)
+        qcol = j * bq + lax.broadcasted_iota(jnp.int32, (1, bk, bq), 2)
+        st = jnp.where(krow > qcol, _NEG_INF, st)
+    return st
+
+
+def _fwd_kernel_packed(q_ref, k_ref, v_ref, bias_ref, qseg_ref, kseg_ref,
+                       seed_ref, o_ref, lse_ref,
+                       m_scr, l_scr, acc_scr, *, scale, causal, bq, bk,
+                       nk, hp, dropout_rate, bias_kind, bias_per_head,
+                       has_seg):
+    """_fwd_kernel over hp packed heads: scores stack to (hp, bk, bq),
+    stats/lse are (hp, bq) lane-major blocks, the accumulator is
+    (hp, d, bq).  Per-head math is identical to the unpacked kernel —
+    the packing only batches it."""
+    i = pl.program_id(0)  # batch * head-group
+    j = pl.program_id(1)  # q block
+    t = pl.program_id(2)  # k block
+
+    @pl.when(t == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    def _step(masked):
+        st = jnp.stack([
+            jax.lax.dot_general(k_ref[p], q_ref[p],
+                                (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+            for p in range(hp)]) * scale            # (hp, bk, bq)
+        st = _mask_bias_packed(st, j, t, bq, bk, hp, masked, bias_kind,
+                               bias_ref, bias_per_head, has_seg,
+                               qseg_ref, kseg_ref)
+        m_prev = m_scr[...]                         # (hp, bq)
+        m_new = jnp.maximum(m_prev, jnp.max(st, axis=1))
+        p_exp = jnp.exp(st - m_new[:, None, :])     # (hp, bk, bq)
+        alpha = jnp.exp(m_prev - m_new)
+        l_scr[...] = l_scr[...] * alpha + jnp.sum(p_exp, axis=1)
+        if dropout_rate > 0.0:
+            # per-head coordinate hash with the FLAT batch*head index
+            # i*hp + p — bit-identical to the unpacked kernel's mask
+            keep = jnp.stack([
+                _dropout_keep(seed_ref, i * hp + p, j, t, (bk, bq),
+                              dropout_rate) for p in range(hp)])
+            p_acc = jnp.where(keep, p_exp, 0.0) * (
+                1.0 / (1.0 - dropout_rate))
+        else:
+            p_acc = p_exp
+        acc_scr[...] = acc_scr[...] * alpha[:, None, :] + jnp.stack([
+            jax.lax.dot_general(v_ref[p], p_acc[p].astype(v_ref.dtype),
+                                (((0,), (0,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+            for p in range(hp)])                    # (hp, d, bq)
+        m_scr[...] = m_new
+
+    _causal_dispatch(_step, j, t, bq, bk, causal)
+
+    @pl.when(t == nk - 1)
+    def _epilogue():
+        l = jnp.maximum(l_scr[...], 1e-30)          # (hp, bq)
+        o_ref[...] = jnp.swapaxes(acc_scr[...] / l[:, None, :],
+                                  1, 2).astype(o_ref.dtype)
+        lse_ref[:, j] = m_scr[...] + jnp.log(l)
 
 
 # ------------------------------ backward kernels ----------------------------
@@ -529,6 +640,84 @@ def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     dv_ref[0] = dv_scr[pl.ds(t * bk, bk), :].astype(dv_ref.dtype)
 
 
+def _bwd_fused_kernel_packed(q_ref, k_ref, v_ref, do_ref, lse_ref,
+                             delta_ref, bias_ref, qseg_ref, kseg_ref,
+                             seed_ref, dq_ref, dk_ref, dv_ref,
+                             dq_scr, dk_scr, dv_scr, *, scale, causal,
+                             bq, bk, nq, nk, hp, dropout_rate,
+                             bias_kind, bias_per_head, has_seg):
+    """_bwd_fused_kernel over hp packed heads (no dbias — _bwd_impl
+    drops to the unpacked kernels when a bias gradient is wanted).
+    dq accumulates per (group, q block); dk/dv accumulate across the
+    outer q loop in (hp, sk, d) VMEM scratch — the packed VMEM cap is
+    checked host-side (_FUSED_BWD_CAP_PACKED)."""
+    i = pl.program_id(0)  # batch * head-group
+    j = pl.program_id(1)  # q block (outer)
+    t = pl.program_id(2)  # k block (inner)
+
+    @pl.when(t == 0)
+    def _init_dq():
+        dq_scr[...] = jnp.zeros_like(dq_scr)
+
+    @pl.when((j == 0) & (t == 0))
+    def _init_dkv():
+        dk_scr[...] = jnp.zeros_like(dk_scr)
+        dv_scr[...] = jnp.zeros_like(dv_scr)
+
+    def _step(masked):
+        rows = (slice(None), pl.ds(t * bk, bk), slice(None))
+        st = jnp.stack([
+            jax.lax.dot_general(k_ref[p], q_ref[p],
+                                (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+            for p in range(hp)]) * scale            # (hp, bk, bq)
+        st = _mask_bias_packed(st, j, t, bq, bk, hp, masked, bias_kind,
+                               bias_ref, bias_per_head, has_seg,
+                               qseg_ref, kseg_ref)
+        p_exp = jnp.exp(st - lse_ref[:, j][:, None, :])
+        dp = jnp.stack([
+            jax.lax.dot_general(v_ref[p], do_ref[p],
+                                (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+            for p in range(hp)])                    # (hp, bk, bq)
+        if dropout_rate > 0.0:
+            keep = jnp.stack([
+                _dropout_keep(seed_ref, i * hp + p, j, t, (bk, bq),
+                              dropout_rate) for p in range(hp)])
+            inv = 1.0 / (1.0 - dropout_rate)
+            p_v = jnp.where(keep, p_exp, 0.0) * inv
+            dp = jnp.where(keep, dp, 0.0) * inv
+        else:
+            p_v = p_exp
+        dv_scr[rows] += jnp.stack([
+            jax.lax.dot_general(p_v[p].astype(do_ref.dtype), do_ref[p],
+                                (((1,), (0,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+            for p in range(hp)])                    # (hp, bk, d)
+        ds = p_exp * (dp - delta_ref[:, j][:, None, :])
+        dk_scr[rows] += scale * jnp.stack([
+            jax.lax.dot_general(ds[p].astype(q_ref.dtype), q_ref[p],
+                                (((1,), (0,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+            for p in range(hp)])                    # (hp, bk, d)
+        dq_scr[...] += scale * jnp.stack([
+            jax.lax.dot_general(ds[p].astype(k_ref.dtype), k_ref[p],
+                                (((0,), (0,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+            for p in range(hp)])                    # (hp, bq, d)
+
+    _causal_dispatch(_step, j, t, bq, bk, causal)
+
+    @pl.when(t == nk - 1)
+    def _write_dq():
+        dq_ref[...] = dq_scr[...].astype(dq_ref.dtype)
+
+    # dk/dv flushed every t step (block index advances with t); only
+    # the final q pass leaves the complete sums (≡ _bwd_fused_kernel)
+    dk_ref[...] = dk_scr[:, pl.ds(t * bk, bk), :].astype(dk_ref.dtype)
+    dv_ref[...] = dv_scr[:, pl.ds(t * bk, bk), :].astype(dv_ref.dtype)
+
+
 # ----------------------------- host-side plumbing ---------------------------
 
 def _pick_block(seq, cap=512):
@@ -538,22 +727,68 @@ def _pick_block(seq, cap=512):
     return None
 
 
+_BLOCK_FALLBACK_WARNED = set()
+
+
+def _fit_block(blk, seq, name):
+    """Largest power-of-two block <= blk that divides seq.  Tuned
+    configs are swept at the bench shapes; an off-size sequence (odd
+    microbatch remainder, a probe script) must degrade to a dividing
+    block instead of hard-failing mid-training (warn once per
+    (name, blk, seq))."""
+    if blk is None or seq % blk == 0:
+        return blk
+    fb = _pick_block(seq, cap=blk)
+    if fb is None:
+        raise ValueError(
+            f"{name}={blk} does not divide seq={seq} and no smaller "
+            f"power-of-two block divides it either")
+    key = (name, blk, seq)
+    if key not in _BLOCK_FALLBACK_WARNED:
+        _BLOCK_FALLBACK_WARNED.add(key)
+        warnings.warn(
+            f"flash attention: {name}={blk} does not divide seq={seq}; "
+            f"falling back to the largest dividing block {fb}",
+            stacklevel=4)
+    return fb
+
+
 def _resolve_blocks(sq, sk, block_q, block_k, full_bias=False):
     """Default blocks, swept on v5e (docs/PERF.md): single block per
     axis when the sequence fits (<=1024 — grid overhead dominates the
     extra causal-mask work), else (512, 1024) to cap the fp32 score
     tile at 2 MB of VMEM while keeping k-side matmuls wide.  Explicit
-    blocks must divide the sequence.  A fused FULL bias adds a
+    blocks that do not divide the sequence fall back to the largest
+    dividing power-of-two block (warn once) so tuned configs never
+    hard-fail on off-size sequences.  A fused FULL bias adds a
     same-size fp32 block, so the q block is halved to stay inside VMEM
     (a key-compact "sk" bias is only a (bk,) row — no halving)."""
-    if block_q is not None and sq % block_q:
-        raise ValueError(f"block_q={block_q} does not divide sq={sq}")
-    if block_k is not None and sk % block_k:
-        raise ValueError(f"block_k={block_k} does not divide sk={sk}")
+    block_q = _fit_block(block_q, sq, "block_q")
+    block_k = _fit_block(block_k, sk, "block_k")
     q_cap = 1024 if (sq <= 1024 and not full_bias) else 512
     bq = block_q or _pick_block(sq, cap=q_cap)
     bk = block_k or _pick_block(sk, cap=1024)
     return bq, bk
+
+
+def _resolve_heads_per_step(heads_per_step, h, want_dbias=False):
+    """Validated packing factor: must divide the (local) head count;
+    dbias paths run unpacked.  Invalid explicit values warn once and
+    fall back to 1 (the tuned path must degrade, not fail)."""
+    hp = int(heads_per_step or 1)
+    if hp <= 1:
+        return 1
+    if want_dbias:
+        return 1
+    if h % hp:
+        key = ("heads_per_step", hp, h)
+        if key not in _BLOCK_FALLBACK_WARNED:
+            _BLOCK_FALLBACK_WARNED.add(key)
+            warnings.warn(
+                f"flash attention: heads_per_step={hp} does not divide "
+                f"num_heads={h}; running unpacked", stacklevel=4)
+        return 1
+    return hp
 
 
 def _compiler_params(grid_len):
@@ -571,12 +806,13 @@ def _flatten_bh(x):
 
 def _fwd_impl(q, k, v, scale, causal, dropout_rate=0.0, seed=None,
               block_q=None, block_k=None, bias=None, q_seg=None,
-              kv_seg=None, q_off=0, k_off=0):
+              kv_seg=None, q_off=0, k_off=0, heads_per_step=1):
     b, h, sq, d = q.shape
     sk = k.shape[2]
     bias_kind = _bias_kind(bias, sk)
     bq, bk = _resolve_blocks(sq, sk, block_q, block_k,
                               full_bias=bias_kind == "full")
+    hp = _resolve_heads_per_step(heads_per_step, h)
     qf, kf, vf = _flatten_bh(q), _flatten_bh(k), _flatten_bh(v)
     bh = b * h
     nq, nk = sq // bq, sk // bk
@@ -588,35 +824,45 @@ def _fwd_impl(q, k, v, scale, causal, dropout_rate=0.0, seed=None,
                                     bias, q_seg, kv_seg, bias_kind)
     bspec, qsspec, ksspec = _extras_specs(
         h, nq, bq, nk, bk, bias_kind, nb, nh, has_seg,
-        jt_from_args=lambda j, t: (j, t))
+        jt_from_args=lambda j, t: (j, t), hp=hp)
+    if hp == 1:
+        kernel = functools.partial(
+            _fwd_kernel, scale=scale, causal=causal, bq=bq, bk=bk,
+            nk=nk, dropout_rate=dropout_rate, bias_kind=bias_kind,
+            has_seg=has_seg)
+        scratch = [pltpu.VMEM((1, bq), jnp.float32),
+                   pltpu.VMEM((1, bq), jnp.float32),
+                   pltpu.VMEM((d, bq), jnp.float32)]
+    else:
+        kernel = functools.partial(
+            _fwd_kernel_packed, scale=scale, causal=causal, bq=bq,
+            bk=bk, nk=nk, hp=hp, dropout_rate=dropout_rate,
+            bias_kind=bias_kind, bias_per_head=nh > 1, has_seg=has_seg)
+        scratch = [pltpu.VMEM((hp, bq), jnp.float32),
+                   pltpu.VMEM((hp, bq), jnp.float32),
+                   pltpu.VMEM((hp, d, bq), jnp.float32)]
     o, lse = pl.pallas_call(
-        functools.partial(_fwd_kernel, scale=scale, causal=causal, bq=bq,
-                          bk=bk, nk=nk, dropout_rate=dropout_rate,
-                          bias_kind=bias_kind, has_seg=has_seg),
-        grid=(bh, nq, nk),
+        kernel,
+        grid=(bh // hp, nq, nk),
         in_specs=[
-            pl.BlockSpec((1, bq, d), lambda i, j, t: (i, j, 0)),
-            pl.BlockSpec((1, bk, d), lambda i, j, t: (i, t, 0)),
-            pl.BlockSpec((1, bk, d), lambda i, j, t: (i, t, 0)),
+            pl.BlockSpec((hp, bq, d), lambda i, j, t: (i, j, 0)),
+            pl.BlockSpec((hp, bk, d), lambda i, j, t: (i, t, 0)),
+            pl.BlockSpec((hp, bk, d), lambda i, j, t: (i, t, 0)),
             bspec, qsspec, ksspec,
             pl.BlockSpec((3, 1), lambda i, j, t: (0, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, bq, d), lambda i, j, t: (i, j, 0)),
-            # lse as (bh, nq, bq): one whole-head block resident per i
-            # (a (bh, sq, 1) fp32 array would tile-pad to 128x its
+            pl.BlockSpec((hp, bq, d), lambda i, j, t: (i, j, 0)),
+            # lse as (bh, nq, bq): one whole-head(-group) block resident
+            # per i (a (bh, sq, 1) fp32 array would tile-pad to 128x its
             # size; 2-D (1, bq) blocks violate the (8, 128) tile rule)
-            pl.BlockSpec((1, nq, bq), lambda i, j, t: (i, 0, 0)),
+            pl.BlockSpec((hp, nq, bq), lambda i, j, t: (i, 0, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
             jax.ShapeDtypeStruct((bh, nq, bq), jnp.float32),
         ],
-        scratch_shapes=[
-            pltpu.VMEM((1, bq), jnp.float32),
-            pltpu.VMEM((1, bq), jnp.float32),
-            pltpu.VMEM((d, bq), jnp.float32),
-        ],
+        scratch_shapes=scratch,
         # the q-block axis must stay sequential here: the whole-head lse
         # block is shared across j, and a Megacore split of a "parallel"
         # j would give each core a private copy with half the rows
@@ -638,7 +884,7 @@ def _head_row_spec(nq, bq):
 def _bwd_impl(q, k, v, o, lse, do, scale, causal, dropout_rate=0.0,
               seed=None, block_q=None, block_k=None, bias=None,
               q_seg=None, kv_seg=None, want_dbias=False,
-              grad_dtype=None, q_off=0, k_off=0):
+              grad_dtype=None, q_off=0, k_off=0, heads_per_step=1):
     """Returns (dq, dk, dv, dbias) — dbias is None unless want_dbias.
 
     grad_dtype overrides the dq/dk/dv output dtype (default: the input
@@ -651,6 +897,8 @@ def _bwd_impl(q, k, v, o, lse, do, scale, causal, dropout_rate=0.0,
     bias_kind = _bias_kind(bias, sk)
     bq, bk = _resolve_blocks(sq, sk, block_q, block_k,
                               full_bias=bias_kind == "full")
+    hp = _resolve_heads_per_step(heads_per_step, h,
+                                 want_dbias=want_dbias)
     nq, nk = sq // bq, sk // bk
     bh = b * h
     seed = _seed3(seed, q_off, k_off)
@@ -692,6 +940,39 @@ def _bwd_impl(q, k, v, o, lse, do, scale, causal, dropout_rate=0.0,
     # over the inner j axis), so it forces the two-kernel path
     dbias_full = want_dbias and bias_kind == "full"
     dbias_sk = want_dbias and bias_kind == "sk"
+
+    # head-packed single-pass backward: only when the fused path is
+    # live anyway, no bias gradient is wanted (dbias writes are
+    # per-head), and the (hp, sk, d) dk/dv scratch pair fits VMEM
+    if (hp > 1 and sk * d <= _FUSED_BWD_CAP and not want_dbias
+            and hp * sk * d <= _FUSED_BWD_CAP_PACKED):
+        bspec_p, qsspec_p, ksspec_p = _extras_specs(
+            h, nq, bq, nk, bk, bias_kind, nb, nh, has_seg,
+            jt_from_args=lambda j, t: (j, t), hp=hp)
+        qspec_p = pl.BlockSpec((hp, bq, d), lambda i, j, t: (i, j, 0))
+        kspec_p = pl.BlockSpec((hp, bk, d), lambda i, j, t: (i, t, 0))
+        rp = pl.BlockSpec((hp, nq, bq), lambda i, j, t: (i, 0, 0))
+        dq, dk, dv = pl.pallas_call(
+            functools.partial(_bwd_fused_kernel_packed, nq=nq, nk=nk,
+                              hp=hp, bias_per_head=nh > 1, **static),
+            grid=(bh // hp, nq, nk),
+            in_specs=[qspec_p, kspec_p, kspec_p, qspec_p, rp, rp,
+                      bspec_p, qsspec_p, ksspec_p,
+                      pl.BlockSpec((3, 1), lambda i, j, t: (0, 0))],
+            out_specs=[qspec_p, kspec_p, kspec_p],
+            out_shape=[jax.ShapeDtypeStruct((bh, sq, d), dq_dt),
+                       jax.ShapeDtypeStruct((bh, sk, d), dk_dt),
+                       jax.ShapeDtypeStruct((bh, sk, d), dv_dt)],
+            scratch_shapes=[pltpu.VMEM((hp, bq, d), jnp.float32),
+                            pltpu.VMEM((hp, sk, d), jnp.float32),
+                            pltpu.VMEM((hp, sk, d), jnp.float32)],
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("parallel", "arbitrary",
+                                     "arbitrary")),
+            interpret=pallas_interpret(),
+        )(*args)
+        return (dq.reshape(q.shape), dk.reshape(k.shape),
+                dv.reshape(v.shape), None)
 
     # single-pass fused backward while the full-(sk, d) dk/dv scratch
     # fits VMEM comfortably; two-kernel fallback for long context
@@ -798,23 +1079,26 @@ def _bwd_impl(q, k, v, o, lse, do, scale, causal, dropout_rate=0.0,
             dv.reshape(v.shape), dbias)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(6, 7, 8, 9, 10, 11))
+@functools.partial(jax.custom_vjp,
+                   nondiff_argnums=(6, 7, 8, 9, 10, 11, 12))
 def _flash(q, k, v, bias, q_seg, kv_seg, scale, causal, dropout_rate,
-           block_q, block_k, bias_grad, seed):
+           block_q, block_k, heads_per_step, bias_grad, seed):
     o, _ = _fwd_impl(q, k, v, scale, causal, dropout_rate, seed,
-                     block_q, block_k, bias, q_seg, kv_seg)
+                     block_q, block_k, bias, q_seg, kv_seg,
+                     heads_per_step=heads_per_step)
     return o
 
 
 def _flash_fwd(q, k, v, bias, q_seg, kv_seg, scale, causal, dropout_rate,
-               block_q, block_k, bias_grad, seed):
+               block_q, block_k, heads_per_step, bias_grad, seed):
     o, lse = _fwd_impl(q, k, v, scale, causal, dropout_rate, seed,
-                       block_q, block_k, bias, q_seg, kv_seg)
+                       block_q, block_k, bias, q_seg, kv_seg,
+                       heads_per_step=heads_per_step)
     return o, (q, k, v, bias, q_seg, kv_seg, o, lse, seed)
 
 
-def _flash_bwd(scale, causal, dropout_rate, block_q, block_k, bias_grad,
-               res, do):
+def _flash_bwd(scale, causal, dropout_rate, block_q, block_k,
+               heads_per_step, bias_grad, res, do):
     q, k, v, bias, q_seg, kv_seg, o, lse, seed = res
     # a key-broadcast (.., *, 1) bias adds a per-query constant to the
     # scores — softmax cancels it, so its gradient is EXACTLY zero (no
@@ -824,7 +1108,8 @@ def _flash_bwd(scale, causal, dropout_rate, block_q, block_k, bias_grad,
     dq, dk, dv, dbias = _bwd_impl(q, k, v, o, lse, do, scale, causal,
                                   dropout_rate, seed, block_q, block_k,
                                   bias, q_seg, kv_seg,
-                                  want_dbias=want_dbias)
+                                  want_dbias=want_dbias,
+                                  heads_per_step=heads_per_step)
     import numpy as _np
 
     def _int_zero(x):
@@ -842,6 +1127,53 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 
 # --------------------------------- public API -------------------------------
 
+# cache-sourced score-tile guard: hp·bq·bk fp32 elements must stay
+# within ~4 MB of VMEM (the sweep's own candidate cap is half this)
+_TUNED_SCORE_ELEMS_CAP = 1024 * 1024
+
+
+def _tuned_flash_config(b, h, sq, sk, d, dtype, causal, bias_kind,
+                        has_seg):
+    """Trace-time autotuner lookup (apex_tpu.tune): a pure host-side
+    dict access — zero collectives, no host syncs.  None on a miss, so
+    an empty cache leaves every call on today's heuristics.
+
+    A hit is SANITY-VALIDATED before use (a hand-edited or
+    cross-version cache must degrade to heuristics, never crash a run):
+    blocks and packing must be ints in range and the packed fp32 score
+    tile must fit VMEM; anything off warns once and is ignored
+    (divisibility fixups happen later in _resolve_blocks /
+    _resolve_heads_per_step)."""
+    try:
+        from apex_tpu import tune
+    except Exception:  # pragma: no cover — tune must never break attn
+        return None
+    if sq != sk:
+        return None   # tuned entries are swept at self-attention shapes
+    cfg = tune.tuned("flash_sdpa",
+                     tune.flash_attrs(b, h, sq, sk, d, dtype, causal,
+                                      bias=bias_kind, seg=has_seg))
+    if not cfg:
+        return None
+    bq = cfg.get("block_q")
+    bk = cfg.get("block_k")
+    hp = cfg.get("heads_per_step", 1)
+    ok = (all(v is None or (isinstance(v, int) and 8 <= v <= 4096)
+              for v in (bq, bk))
+          and isinstance(hp, int) and 1 <= hp <= 16
+          and hp * (bq or 1024) * (bk or 1024) <= _TUNED_SCORE_ELEMS_CAP)
+    if not ok:
+        key = ("tuned_cfg", sq, sk, d)
+        if key not in _BLOCK_FALLBACK_WARNED:
+            _BLOCK_FALLBACK_WARNED.add(key)
+            warnings.warn(
+                f"flash attention: ignoring out-of-range tuned config "
+                f"{cfg} at (sq={sq}, sk={sk}, d={d}); using heuristics",
+                stacklevel=3)
+        return None
+    return cfg
+
+
 def flash_attention(q, k, v, *, causal: bool = False,
                     softmax_scale: Optional[float] = None,
                     bias=None,
@@ -852,6 +1184,7 @@ def flash_attention(q, k, v, *, causal: bool = False,
                     dropout_key=None,
                     block_q: Optional[int] = None,
                     block_k: Optional[int] = None,
+                    heads_per_step: Optional[int] = None,
                     # True by default DELIBERATELY: a trainable bias
                     # silently freezing (the round-3 contract) is wrong
                     # training with no error; the full-bias dbias
@@ -887,6 +1220,17 @@ def flash_attention(q, k, v, *, causal: bool = False,
     softmax cancels it exactly (finite values; whole-row masking must
     use segment ids), so it is skipped in the kernels and its gradient
     is exactly zero.
+
+    block_q / block_k / heads_per_step: the kernel-shape knobs.
+    heads_per_step > 1 packs that many d-minor heads into each grid
+    step (shared online-softmax epilogue, hp-head K/V slabs per DMA —
+    the d=64 packing axis; see _fwd_kernel_packed).  When ALL THREE are
+    None the apex_tpu.tune cache is consulted at trace time for a
+    config tuned at this exact (shape, dtype, device-kind) key — a
+    cache miss (or APEX_TPU_TUNE=0) keeps the built-in heuristics, so
+    an empty cache is byte-identical to explicit None everywhere.
+    Explicit blocks that do not divide the sequence fall back to the
+    largest dividing block (warn once) instead of failing.
 
     segment_ids: (b, s) int — tokens attend only where ids are equal;
     this is the TPU-native form of the reference fmha's cu_seqlens
@@ -931,6 +1275,16 @@ def flash_attention(q, k, v, *, causal: bool = False,
     kernel_ok = (use_pallas(use_pallas_override)
                  and _pick_block(q.shape[2]) and _pick_block(k.shape[2]))
     if kernel_ok:
+        if block_q is None and block_k is None and heads_per_step is None:
+            # fully-unspecified config → consult the autotuner cache
+            # (explicit knobs always win; a miss keeps the heuristics)
+            cfg = _tuned_flash_config(
+                b, h, sq, sk, q.shape[3], q.dtype, causal,
+                _bias_kind(bias, sk), q_segment_ids is not None)
+            if cfg:
+                block_q = cfg.get("block_q")
+                block_k = cfg.get("block_k")
+                heads_per_step = cfg.get("heads_per_step")
         if dropout_rate > 0.0:
             seed = jax.random.randint(dropout_key, (1, 1), -2**31, 2**31 - 1,
                                       dtype=jnp.int32)
@@ -938,7 +1292,8 @@ def flash_attention(q, k, v, *, causal: bool = False,
             seed = jnp.zeros((1, 1), jnp.int32)
         return _flash(q, k, v, bias, q_segment_ids, kv_segment_ids,
                       scale, causal, float(dropout_rate),
-                      block_q, block_k, bool(bias_grad), seed)
+                      block_q, block_k, int(heads_per_step or 1),
+                      bool(bias_grad), seed)
     # fallback keeps the same dbias semantics: AD through the dense
     # path yields the (broadcast-reduced) dbias when bias_grad, and a
     # stop_gradient reproduces the constant-bias contract otherwise

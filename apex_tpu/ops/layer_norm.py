@@ -24,7 +24,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.experimental import pallas as pl
 
-from apex_tpu.ops._common import (pallas_interpret, row_block,
+from apex_tpu.ops._common import (pallas_interpret, tuned_row_block,
                                   use_pallas_fusable)
 
 
@@ -121,7 +121,7 @@ def _fwd_pallas(x2, weight, bias, eps, rms):
     rows, hidden = x2.shape
     affine = weight is not None
     has_bias = bias is not None
-    blk = row_block(rows, hidden)
+    blk = tuned_row_block("layer_norm_fwd", rows, hidden)
     x2p, _ = _pad_rows(x2, blk)
     prows = x2p.shape[0]
     grid = prows // blk
@@ -155,7 +155,7 @@ def _fwd_pallas(x2, weight, bias, eps, rms):
 def _bwd_pallas(g2, x2, mean, rstd, weight, rms):
     rows, hidden = x2.shape
     affine = weight is not None
-    blk = row_block(rows, hidden)
+    blk = tuned_row_block("layer_norm_bwd", rows, hidden)
     g2p, _ = _pad_rows(g2, blk)
     x2p, _ = _pad_rows(x2, blk)
     meanp, _ = _pad_rows(mean, blk)

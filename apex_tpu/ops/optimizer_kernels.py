@@ -47,6 +47,25 @@ def _to2d(flat):
     return flat.reshape(-1, _LANES), n
 
 
+def _block_rows(rows: int, kernel: str) -> int:
+    """Rows-per-block for a flat kernel's grid: the autotuned value for
+    (kernel, pow2-bucketed rows) when it divides the (FLAT_TILE-padded)
+    row count, else the swept default _BLOCK_ROWS.  Trace-time lookup
+    only (apex_tpu.tune) — an empty cache is byte-identical to the
+    constant."""
+    try:
+        from apex_tpu import tune
+        cfg = tune.tuned("opt_flat", dict(kernel=kernel,
+                                          rows=tune.pow2_bucket(rows)))
+    except Exception:  # pragma: no cover — tuner must never break opts
+        return _BLOCK_ROWS
+    if cfg:
+        br = cfg.get("block_rows")
+        if isinstance(br, int) and 8 <= br <= 4096 and rows % br == 0:
+            return br
+    return _BLOCK_ROWS
+
+
 def _from2d(x2, n):
     return x2.reshape(-1)[:n]
 
@@ -60,8 +79,9 @@ def _elementwise_call(kernel, arrays, n_out, interpret_override=None):
     two_d = [_to2d(a)[0] for a in arrays]
     n = arrays[0].shape[0]
     rows = two_d[0].shape[0]
-    grid = rows // _BLOCK_ROWS
-    spec = pl.BlockSpec((_BLOCK_ROWS, _LANES), lambda i: (i, 0))
+    R = _block_rows(rows, "elementwise")
+    grid = rows // R
+    spec = pl.BlockSpec((R, _LANES), lambda i: (i, 0))
     interp = pallas_interpret() if interpret_override is None else interpret_override
     outs = pl.pallas_call(
         kernel,
@@ -167,8 +187,9 @@ def adam_flat(p, m, v, g, lr, step, *, beta1=0.9, beta2=0.999, eps=1e-8,
     v2, _ = _to2d(v)
     g2, _ = _to2d(g)
     rows = p2.shape[0]
-    grid = rows // _BLOCK_ROWS
-    spec = pl.BlockSpec((_BLOCK_ROWS, _LANES), lambda i: (i, 0))
+    R = _block_rows(rows, "adam")
+    grid = rows // R
+    spec = pl.BlockSpec((R, _LANES), lambda i: (i, 0))
     sspec = pl.BlockSpec((9, 1), lambda i: (0, 0))
     pn, mn, vn = pl.pallas_call(
         kernel,
@@ -286,7 +307,7 @@ def adam_flat_seg(p, m, v, g, lr, step, *, wd_values, lr_scale_values,
     m2, _ = _to2d(m)
     v2, _ = _to2d(v)
     g2, _ = _to2d(g)
-    R = _BLOCK_ROWS
+    R = _block_rows(p2.shape[0], "adam_seg")
     grid = p2.shape[0] // R
     lo, hi = _seg_row_bounds(spec, npad)
     vals = _seg_vals2(wd_values, lr_scale_values, npad)
@@ -412,8 +433,9 @@ def sgd_flat(p, buf, g, lr, *, momentum=0.0, dampening=0.0, nesterov=False,
     p2, n = _to2d(p)
     b2, _ = _to2d(buf)
     g2, _ = _to2d(g)
-    grid = p2.shape[0] // _BLOCK_ROWS
-    spec = pl.BlockSpec((_BLOCK_ROWS, _LANES), lambda i: (i, 0))
+    R = _block_rows(p2.shape[0], "sgd")
+    grid = p2.shape[0] // R
+    spec = pl.BlockSpec((R, _LANES), lambda i: (i, 0))
     sspec = pl.BlockSpec((4, 1), lambda i: (0, 0))
     pn, bn = pl.pallas_call(
         kernel,
@@ -466,8 +488,9 @@ def adagrad_flat(p, h, g, lr, *, eps=1e-10, weight_decay=0.0,
     p2, n = _to2d(p)
     h2, _ = _to2d(h)
     g2, _ = _to2d(g)
-    grid = p2.shape[0] // _BLOCK_ROWS
-    spec = pl.BlockSpec((_BLOCK_ROWS, _LANES), lambda i: (i, 0))
+    R = _block_rows(p2.shape[0], "adagrad")
+    grid = p2.shape[0] // R
+    spec = pl.BlockSpec((R, _LANES), lambda i: (i, 0))
     sspec = pl.BlockSpec((1, 1), lambda i: (0, 0))
     pn, hn = pl.pallas_call(
         kernel,
@@ -589,8 +612,9 @@ def lamb_phase1_flat(m, v, g, p, clip_ratio, step, *, beta1, beta2, eps,
     v2, _ = _to2d(v)
     g2, _ = _to2d(g)
     p2, _ = _to2d(p)
-    grid = m2.shape[0] // _BLOCK_ROWS
-    spec = pl.BlockSpec((_BLOCK_ROWS, _LANES), lambda i: (i, 0))
+    R = _block_rows(m2.shape[0], "lamb1")
+    grid = m2.shape[0] // R
+    spec = pl.BlockSpec((R, _LANES), lambda i: (i, 0))
     sspec = pl.BlockSpec((8, 1), lambda i: (0, 0))
     mn, vn, u = pl.pallas_call(
         kernel,
@@ -643,7 +667,7 @@ def lamb_phase1_seg(m, v, g, p, clip_ratio, step, *, wd_values, spec,
     v2, _ = _to2d(v)
     g2, _ = _to2d(g)
     p2, _ = _to2d(p)
-    R = _BLOCK_ROWS
+    R = _block_rows(m2.shape[0], "lamb1_seg")
     grid = m2.shape[0] // R
     lo, hi = _seg_row_bounds(spec, npad)
     vals8 = jnp.zeros((8, npad), jnp.float32).at[0, :n_seg].set(wd_values)
@@ -712,7 +736,7 @@ def lamb_phase2_seg(p, u, ratio_values, spec, lr, *, row_offset=0,
                                 use_pallas_override=use_pallas_override)
     p2, n = _to2d(p)
     u2, _ = _to2d(u)
-    R = _BLOCK_ROWS
+    R = _block_rows(p2.shape[0], "lamb2_seg")
     nb = p2.shape[0] // R
     lo, hi = _seg_row_bounds(spec, npad)
     vals8 = jnp.broadcast_to(
@@ -744,8 +768,9 @@ def lamb_phase2_flat(p, u, ratio_elem, lr, use_pallas_override=None):
     p2, n = _to2d(p)
     u2, _ = _to2d(u)
     r2, _ = _to2d(ratio_elem)
-    grid = p2.shape[0] // _BLOCK_ROWS
-    spec = pl.BlockSpec((_BLOCK_ROWS, _LANES), lambda i: (i, 0))
+    R = _block_rows(p2.shape[0], "lamb2")
+    grid = p2.shape[0] // R
+    spec = pl.BlockSpec((R, _LANES), lambda i: (i, 0))
     sspec = pl.BlockSpec((1, 1), lambda i: (0, 0))
     pn = pl.pallas_call(
         _lamb_phase2_kernel,
@@ -887,7 +912,7 @@ def _per_tensor_sumsq_2d(x2, spec, n_seg, row_offset):
     one-hot matmuls.  `row_offset` is this buffer's global starting row
     (0 for a full buffer; rank*shard_rows for a shard — may be traced)."""
     rows = x2.shape[0]
-    R = _BLOCK_ROWS
+    R = _block_rows(rows, "sumsq_seg")
     nb = rows // R
     npad = _seg_pad(n_seg)
     lo, hi = _seg_row_bounds(spec, npad)

@@ -25,7 +25,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.experimental import pallas as pl
 
-from apex_tpu.ops._common import pallas_interpret, row_block, use_pallas
+from apex_tpu.ops._common import (pallas_interpret, row_block,
+                                  tuned_row_block, use_pallas)
 
 _MASK_VALUE = -10000.0
 
@@ -86,7 +87,7 @@ def _pad_rows(a, blk):
 def _fwd_pallas(x2, mask2, scale, causal, sq):
     rows, sk = x2.shape
     has_mask = mask2 is not None
-    blk = row_block(rows, sk)
+    blk = tuned_row_block("softmax_fwd", rows, sk)
     xp = _pad_rows(x2, blk)
     prows = xp.shape[0]
     grid = prows // blk
@@ -119,7 +120,7 @@ def _fwd_pallas(x2, mask2, scale, causal, sq):
 
 def _bwd_pallas(g2, y2, scale):
     rows, sk = g2.shape
-    blk = row_block(rows, sk)
+    blk = tuned_row_block("softmax_bwd", rows, sk)
     gp, yp = _pad_rows(g2, blk), _pad_rows(y2, blk)
     prows = gp.shape[0]
     dx = pl.pallas_call(

@@ -105,6 +105,18 @@ class _ShardedFlat(F.FlatCheckpointMixin):
         of ZeRO-2).  Shard-local: call inside shard_map."""
         return self._gather_full(state.params_shard)
 
+    def state_partition_specs(self):
+        """PartitionSpec pytree for this optimizer's state NamedTuple:
+        `step` replicated, every flat shard buffer split over the dp
+        axis.  Feed to shard_map in/out_specs — ddp.make_train_step
+        detects this method and shards the optimizer state instead of
+        replicating it (the ZeRO-2 hot-path wiring)."""
+        from jax.sharding import PartitionSpec as P
+
+        return self._STATE(*[
+            P() if f == "step" else P(self.axis_name)
+            for f in self._STATE._fields])
+
 
 class DistributedFusedAdam(_ShardedFlat):
     """ZeRO-2 Adam.  Shard-local: init/step run inside shard_map with the

@@ -149,6 +149,26 @@ def make_train_step(loss_fn: Callable, optimizer, mesh, *,
     grads flow to the grad pmean and the fused optimizer as-is (the
     flat kernels take any float grad dtype).
 
+    ZERO-2: `optimizer` may be a sharded optimizer
+    (`DistributedFusedAdam` / `DistributedFusedLAMB` — detected via
+    their `state_partition_specs`/`full_params` methods).  The step
+    then skips the full grad allreduce entirely — the optimizer's
+    per-bucket `psum_scatter` IS the grad sync (and with
+    `n_buckets > 1` each bucket's collective can overlap the remaining
+    backward) — reconstructs full params from the rank shard via
+    `full_params`, and the opt-state in/out specs shard the flat
+    buffers over `axis_name`.  Initialize the state INSIDE shard_map
+    (see docs/optimizers.md):
+
+        sspec = opt.state_partition_specs()
+        state = jax.jit(shard_map(opt.init, mesh=mesh, in_specs=(P(),),
+                                  out_specs=sspec, check_vma=False))(params)
+
+    With amp, the overflow flag is the psum-OR of each rank's local
+    check (grads are never globally materialized); the metrics
+    grad-norm is the local pre-reduction norm, while param/update
+    norms are exact global values (scalar psum over the rank shards).
+
     metrics enables on-device telemetry (apex_tpu.monitor): pass True
     or a `monitor.MetricsConfig`.  The returned step then takes a
     trailing `monitor.MetricsState` argument and returns the updated
@@ -167,6 +187,14 @@ def make_train_step(loss_fn: Callable, optimizer, mesh, *,
 
     policy = amp_state.policy if amp_state is not None else None
     dynamic = amp_state.dynamic if amp_state is not None else False
+    sharded_opt = (hasattr(optimizer, "state_partition_specs")
+                   and hasattr(optimizer, "full_params"))
+    # ZeRO optimizers that support it skip the step-tail param gather
+    # entirely: the NEXT step's full_params() reconstructs them, letting
+    # XLA overlap the all-gather with the start of forward compute
+    import inspect
+    skip_gather = (sharded_opt and "gather_params"
+                   in inspect.signature(optimizer.step).parameters)
     if num_microbatches < 1:
         raise ValueError(f"num_microbatches must be >= 1, got "
                          f"{num_microbatches}")
@@ -183,7 +211,12 @@ def make_train_step(loss_fn: Callable, optimizer, mesh, *,
     def local_step(opt_state, scaler_state, model_state, batch,
                    metrics_state=None):
         raw_batch = batch
-        params = F.unflatten(opt_state.params, optimizer.spec)
+        if sharded_opt:
+            # ZeRO-2: all-gather full params from this rank's shard;
+            # XLA schedules the gather under the start of forward
+            params = optimizer.full_params(opt_state)
+        else:
+            params = F.unflatten(opt_state.params, optimizer.spec)
         if policy is not None:
             params = policy.cast_to_param(params)
             if policy.compute_dtype != jnp.float32:
@@ -255,11 +288,20 @@ def make_train_step(loss_fn: Callable, optimizer, mesh, *,
             # per-microbatch auxes (leading dim m)
             aux = mstate_f if with_state else (
                 auxs if has_aux else None)
-        grads = sync_gradients(grads, axis_name, average=True)
+        if not sharded_opt:
+            grads = sync_gradients(grads, axis_name, average=True)
+        # else: the sharded optimizer's per-bucket psum_scatter IS the
+        # grad sync — a prior allreduce would double the collective
+        # traffic and defeat the backward overlap
 
         if scaler_state is not None:
             inv = 1.0 / scaler_state.scale
             found_inf = amp_lib.scaler.check_finite(grads)
+            if sharded_opt:
+                # local (pre-reduction) check: psum-OR so every rank
+                # takes the same skip/scale decision
+                found_inf = jax.lax.psum(
+                    found_inf.astype(jnp.float32), axis_name) > 0
             new_scaler = amp_lib.scaler.update(scaler_state, found_inf,
                                                dynamic=dynamic)
         else:
@@ -267,8 +309,10 @@ def make_train_step(loss_fn: Callable, optimizer, mesh, *,
             found_inf = jnp.zeros((), bool)
             new_scaler = None
 
+        step_kw = {"gather_params": False} if skip_gather else {}
         new_params, new_opt_state = optimizer.step(
-            opt_state, grads, inv_scale=inv, found_inf=found_inf)
+            opt_state, grads, inv_scale=inv, found_inf=found_inf,
+            **step_kw)
         outs = (new_opt_state, new_scaler)
         if with_state:
             outs = outs + (aux,)
@@ -283,10 +327,22 @@ def make_train_step(loss_fn: Callable, optimizer, mesh, *,
                 tokens = (_mon.infer_tokens_per_step(raw_batch)
                           * jax.lax.axis_size(axis_name))
             # flat optimizers carry the master buffer as state.params;
-            # norms read it directly (no per-leaf tree walk)
+            # norms read it directly (no per-leaf tree walk).  ZeRO
+            # states carry rank SHARDS (params_shard): global norms are
+            # sqrt(psum(shard sumsq)) — two scalar psums, noise next to
+            # the step's collectives
             p_flat = getattr(opt_state, "params", None)
             p_new = getattr(new_opt_state, "params", None)
+            pn_val = un_val = None
             if not metrics_cfg.param_norms:
+                p_flat = p_new = None
+            elif sharded_opt:
+                p_sh = opt_state.params_shard.astype(jnp.float32)
+                p_sh_new = new_opt_state.params_shard.astype(jnp.float32)
+                sums = jax.lax.psum(jnp.stack([
+                    jnp.sum(jnp.square(p_sh)),
+                    jnp.sum(jnp.square(p_sh_new - p_sh))]), axis_name)
+                pn_val, un_val = jnp.sqrt(sums[0]), jnp.sqrt(sums[1])
                 p_flat = p_new = None
             # the step's `loss` output is each shard's LOCAL loss (the
             # P() out-spec takes one shard's value under check_vma=False)
@@ -297,24 +353,28 @@ def make_train_step(loss_fn: Callable, optimizer, mesh, *,
                 metrics_state, loss=global_loss, grads=grads,
                 inv_scale=inv,
                 params_flat=p_flat, new_params_flat=p_new,
+                param_norm=pn_val, update_norm=un_val,
                 loss_scale=scaler_state.scale if scaler_state is not None
                 else 1.0,
                 found_inf=found_inf, tokens=tokens),)
         return outs
 
-    # batch sharded over dp; params/opt state replicated (ZeRO variants
-    # shard them — see optimizers/distributed_fused_adam.py)
+    # batch sharded over dp; params/opt state replicated — unless the
+    # optimizer is a ZeRO variant, whose flat state buffers shard over
+    # the dp axis (state_partition_specs)
     if batch_spec is None:
         batch_spec = P(axis_name)
 
-    out_specs = (P(), P())
+    opt_spec = (optimizer.state_partition_specs() if sharded_opt
+                else P())
+    out_specs = (opt_spec, P())
     if with_state:
         out_specs += (P(),)
     out_specs += (P(),)  # loss
     if has_aux and not with_state:
         out_specs += (P(),)
 
-    in_specs = (P(), P(), P(), batch_spec)
+    in_specs = (opt_spec, P(), P(), batch_spec)
     if metrics_cfg is not None:
         in_specs += (P(),)       # metrics pytree replicated
         out_specs += (P(),)

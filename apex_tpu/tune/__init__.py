@@ -1,0 +1,71 @@
+"""apex_tpu.tune — kernel autotuning (ISSUE 3 tentpole).
+
+Three pieces:
+
+  * cache   — persistent JSON config store keyed by (device kind, op,
+              shape/dtype attrs); committed defaults for v5e ship in
+              defaults.py; $APEX_TPU_TUNE_CACHE overrides the path,
+              APEX_TPU_TUNE=0 disables all lookups.
+  * tuned() — the trace-time lookup kernels call when the caller passed
+              no explicit config: a pure host-side dict access (zero
+              collectives, no host syncs inside jitted steps).  Returns
+              None on a miss — every kernel then falls back to its
+              deterministic heuristic, byte-identical to the un-tuned
+              framework.
+  * search  — the OFFLINE sweep driver (never times inside a jitted
+              step): times candidate configs wall-clock and records the
+              winners.  `scripts/gpt_anatomy.py tune` is the CLI.
+
+Tunable surfaces wired in this round: flash attention block_q/block_k +
+heads_per_step head packing (ops/flash_attention.py), the softmax and
+layer-norm row blocks (via ops._common.tuned_row_block), and the flat
+optimizer kernels' rows-per-block (ops/optimizer_kernels.py).
+"""
+
+from apex_tpu.tune.cache import (  # noqa: F401
+    ENV_CACHE_PATH,
+    ENV_DISABLE,
+    SCHEMA_VERSION,
+    cache_path,
+    device_kind,
+    fingerprint,
+    invalidate,
+    lookup,
+    make_key,
+    record,
+    reset_stats,
+    stats,
+)
+
+
+def pow2_bucket(n: int) -> int:
+    """Round up to the next power of two — the size coordinate of keys
+    whose exact value shouldn't fragment the cache (row counts)."""
+    n = max(1, int(n))
+    return 1 << (n - 1).bit_length()
+
+
+def flash_attrs(b, h, sq, sk, d, dtype, causal, bias="none", seg=False):
+    """The ONE definition of the flash_sdpa lookup-key attrs — shared
+    by the runtime lookup (ops/flash_attention.py), the sweep driver
+    (tune/search.py), and the committed defaults (tune/defaults.py).
+    A key-schema change here reaches all three or none.  dtype None
+    means the bench dtype, bfloat16."""
+    import jax.numpy as jnp
+
+    dtype = jnp.bfloat16 if dtype is None else dtype
+    return dict(b=int(b), h=int(h), sq=int(sq), sk=int(sk), d=int(d),
+                dtype=jnp.dtype(dtype).name, causal=bool(causal),
+                bias=bias, seg=bool(seg))
+
+
+def tuned(op: str, attrs=None, **kw):
+    """Tuned config for (op, attrs) on this device kind, or None.
+
+    attrs values must be ints/bools/strings (canonicalized into the
+    cache key).  Call at TRACE time only with static shapes — the
+    lookup itself touches no device state.
+    """
+    a = dict(attrs or {})
+    a.update(kw)
+    return lookup(op, a)

@@ -373,6 +373,72 @@ def _long_context_32k(on_tpu):
     return dt * 1e3, B * S / dt
 
 
+def _zero2_bucket_sweep(on_tpu):
+    """ZeRO-2 DistributedFusedAdam wired through ddp.make_train_step
+    (ISSUE 3 satellite): sweep the n_buckets backward-overlap knob over
+    the local dp axis.  With one chip dp=1 — the sweep still exercises
+    the per-bucket reduce-scatter/update/gather pipeline structure, and
+    on multi-chip runs it measures the real overlap.  Returns
+    {"dp": world, "tokens_per_sec": {n_buckets: value}}."""
+    from apex_tpu.models.gpt import GPT, GPTConfig
+    from apex_tpu.optimizers.distributed_fused_adam import (
+        DistributedFusedAdam,
+    )
+    from apex_tpu.parallel import ddp
+    from apex_tpu.parallel import mesh as M
+    # after apex_tpu: _compat shims `jax.shard_map` on jax 0.4.x
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    if on_tpu:
+        batch, seq = 8, 1024
+        cfg = GPTConfig(vocab_size=50304, seq_len=seq, hidden=1024,
+                        num_layers=8, num_heads=16, dropout=0.0,
+                        dtype=jnp.bfloat16, logits_dtype=jnp.bfloat16,
+                        use_flash_attention=True)
+    else:
+        batch, seq = 2, 64
+        cfg = GPTConfig(vocab_size=512, seq_len=seq, hidden=64,
+                        num_layers=2, num_heads=4, dropout=0.0)
+    out = {}
+    M.destroy_model_parallel()
+    mesh = M.initialize_model_parallel()
+    dp = mesh.devices.size
+    model = GPT(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (batch, seq), 0,
+                                cfg.vocab_size)
+    labels = jnp.roll(tokens, -1, axis=1)
+
+    def loss_fn(p, b):
+        return model.loss(p, b[0], b[1])
+
+    for nb in (1, 2, 4):
+        opt = DistributedFusedAdam(
+            num_shards=dp, lr=1e-4, n_buckets=nb,
+            use_pallas=on_tpu or None,
+            master_dtype=jnp.bfloat16 if on_tpu else jnp.float32)
+        sspec = opt.state_partition_specs()
+        state = jax.jit(shard_map(
+            opt.init, mesh=mesh, in_specs=(P(),), out_specs=sspec,
+            check_vma=False))(params)
+        step = ddp.make_train_step(loss_fn, opt, mesh,
+                                   batch_spec=(P("dp"), P("dp")))
+        iters, warmup = (10, 2) if on_tpu else (2, 1)
+        for _ in range(warmup):
+            state, _, loss = step(state, None, (tokens, labels))
+        _ = np.asarray(loss)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            state, _, loss = step(state, None, (tokens, labels))
+        _ = np.asarray(loss)
+        dt = (time.perf_counter() - t0) / iters
+        out[str(nb)] = round(batch * seq / dt, 1)
+        del state
+    M.destroy_model_parallel()
+    return {"dp": dp, "tokens_per_sec": out}
+
+
 def _adam_1b_step_ms(on_tpu):
     """Fused flat-buffer Adam step at 1B params (fp32 p/m/v, bf16
     grads) — the large-param optimizer north star (BASELINE.md;
@@ -578,6 +644,12 @@ def main():
     except Exception as e:
         result["adam_1b_error"] = repr(e)[:120]
     try:
+        with _timed(durations, "zero2_n_buckets"):
+            result["zero2_n_buckets"] = _retry(_zero2_bucket_sweep,
+                                               on_tpu)
+    except Exception as e:
+        result["zero2_n_buckets_error"] = repr(e)[:120]
+    try:
         with _timed(durations, "long_context_32k"):
             lc_ms, lc_tps = _retry(_long_context_32k, on_tpu)
         result["long_context_32k_fwd_bwd_ms"] = round(lc_ms, 1)
@@ -597,6 +669,14 @@ def main():
     # trajectories comparable as metrics are added across rounds
     result["monitor_schema_version"] = SCHEMA_VERSION
     result["metric_durations_s"] = durations
+    # tuner cache state (ISSUE 3): which tuned configs were active and
+    # how often the kernels hit them — runs with different fingerprints
+    # are not comparing the same kernels
+    try:
+        from apex_tpu import tune
+        result["tuner"] = tune.stats()
+    except Exception as e:
+        result["tuner_error"] = repr(e)[:120]
     print(json.dumps(result))
 
 

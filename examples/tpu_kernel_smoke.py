@@ -113,12 +113,12 @@ def main():
         vv = jax.random.normal(jax.random.PRNGKey(2), (B, H, S, D))
         cc = jax.random.normal(jax.random.PRNGKey(3), (B, H, S, D))
         seed = jnp.asarray([[777]], jnp.int32)
-        o1 = np.asarray(_flash(qq, kk, vv, None, None, None, 0.125, True, 0.2, None, None, False, seed))
-        o2 = np.asarray(_flash(qq, kk, vv, None, None, None, 0.125, True, 0.2, None, None, False, seed))
+        o1 = np.asarray(_flash(qq, kk, vv, None, None, None, 0.125, True, 0.2, None, None, 1, False, seed))
+        o2 = np.asarray(_flash(qq, kk, vv, None, None, None, 0.125, True, 0.2, None, None, 1, False, seed))
         assert np.array_equal(o1, o2), "dropout mask not seed-deterministic"
         # v is linear under a fixed mask: directional FD must be exact,
         # which proves the backward kernels regenerate the forward mask
-        f = lambda v_: jnp.vdot(_flash(qq, kk, v_, None, None, None, 0.125, True, 0.2, None, None, False, seed),
+        f = lambda v_: jnp.vdot(_flash(qq, kk, v_, None, None, None, 0.125, True, 0.2, None, None, 1, False, seed),
                                 cc)
         gv = jax.grad(f)(vv)
         dirv = jax.random.normal(jax.random.PRNGKey(4), vv.shape)
@@ -128,7 +128,7 @@ def main():
         # q-grad along the gradient direction (strong signal vs fp32
         # noise): proves the dq kernel's dp mask matches forward
         fq = lambda q_: jnp.vdot(_flash(q_, kk, vv, None, None, None, 0.125, True, 0.2,
-                                        None, None, False, seed), cc)
+                                        None, None, 1, False, seed), cc)
         g = jax.grad(fq)(qq)
         gn = float(jnp.sqrt(jnp.vdot(g, g)))
         d2 = g / gn

@@ -26,8 +26,19 @@ BERT-Large: b32 s512 bidirectional):
 
 Usage:
   python scripts/gpt_anatomy.py [350m|1p3b|bert|both]      # sublayer anatomy
-  python scripts/gpt_anatomy.py roofline [350m|1p3b|bert]  # per-GEMM table
+  python scripts/gpt_anatomy.py roofline [350m|1p3b|bert|1p3b2k]  # per-GEMM table
   python scripts/gpt_anatomy.py blocks                     # flash block sweep, seq 512
+  python scripts/gpt_anatomy.py tune [targets...]          # autotune + re-emit roofline
+  python scripts/gpt_anatomy.py tune --check [targets...]  # verify committed defaults
+                                                           # (nonzero exit on drift)
+
+`tune` drives apex_tpu.tune.search over each target's flash shape (and
+the flat-Adam block at the 1B point), writes the winners to the
+persistent cache (apex_tpu.tune.cache_path()), then re-emits the
+roofline tables so docs/PERF.md can be refreshed from the same run.
+`tune --check` re-sweeps WITHOUT writing and exits 1 if any committed
+default (apex_tpu/tune/defaults.py) for this device kind no longer wins
+— the CI guard for stale committed configs.
 """
 import functools
 import os
@@ -213,18 +224,21 @@ def _gemm_row(label, m, k, n, per_layer=1):
 
 
 def _flash_row(batch, heads, seq, d, causal, block_q=None, block_k=None,
-               label="flash sdpa (7 mm)"):
+               heads_per_step=None, label="flash sdpa (7 mm)"):
     """The attention kernel as a 7-matmul mix: fwd S=QKᵀ + O=PV, bwd
     recompute-S + dP=dO·Vᵀ + dQ + dK + dV.  Three of the seven contract
     over d; the single-block causal config at seq ≤ 1024 executes the
     full square (no skipped blocks), which the executed-flop accounting
-    reflects."""
+    reflects.  With all config args None the kernel consults the
+    apex_tpu.tune cache — so a tuned machine's roofline row IS the
+    tuned kernel."""
     from apex_tpu.ops.flash_attention import flash_attention
     keys = jax.random.split(jax.random.PRNGKey(0), 3)
     q, k, v = (jax.random.normal(kk, (batch, heads, seq, d), jnp.bfloat16)
                for kk in keys)
     attn = functools.partial(flash_attention, causal=causal,
-                             block_q=block_q, block_k=block_k)
+                             block_q=block_q, block_k=block_k,
+                             heads_per_step=heads_per_step)
 
     def fb(q, k, v):
         out, vjp = jax.vjp(attn, q, k, v)
@@ -253,7 +267,14 @@ def gemm_roofline(name, hidden, layers, heads, batch, seq, vocab=50304,
           flush=True)
     print("|---|---|---|---|---|", flush=True)
     _gemm_row("qkv (M,H)x(H,3H)", m_rows, hidden, 3 * hidden)
-    _flash_row(batch, heads, seq, d, causal)
+    from apex_tpu import tune
+    cfg = tune.tuned("flash_sdpa",
+                     tune.flash_attrs(batch, heads, seq, seq, d,
+                                      "bfloat16", causal))
+    flabel = ("flash sdpa (7 mm)" if not cfg else
+              f"flash tuned q{cfg.get('block_q')}k{cfg.get('block_k')}"
+              f"hp{cfg.get('heads_per_step', 1)}")
+    _flash_row(batch, heads, seq, d, causal, label=flabel)
     _gemm_row("attn_out (M,H)x(H,H)", m_rows, hidden, hidden)
     _gemm_row("mlp_up (M,H)x(H,4H)", m_rows, hidden, 4 * hidden)
     _gemm_row("mlp_down (M,4H)x(4H,H)", m_rows, 4 * hidden, hidden)
@@ -285,24 +306,138 @@ def gemm_roofline(name, hidden, layers, heads, batch, seq, vocab=50304,
 
 
 def flash_block_sweep(batch=32, heads=16, seq=512, d=64, causal=False):
-    """Flash block re-sweep at seq 512 (the BERT/1.3B shape; the round-4
-    sweep only covered seq 1024)."""
+    """Flash block+packing re-sweep at seq 512 (the BERT/1.3B shape; the
+    round-4 sweep only covered seq 1024 and predates head packing)."""
     print(f"--- flash blocks @ b{batch} H{heads} s{seq} d{d} "
           f"causal={causal}", flush=True)
-    for bq, bk in ((None, None), (512, 512), (256, 512), (512, 256),
-                   (256, 256)):
+    for bq, bk, hp in ((None, None, None), (512, 512, 1), (256, 512, 1),
+                       (512, 256, 1), (256, 256, 1), (512, 512, 2),
+                       (256, 512, 2), (512, 256, 4), (256, 256, 4)):
         try:
             t, _, _ = _flash_row(batch, heads, seq, d, causal,
                                  block_q=bq, block_k=bk,
-                                 label=f"blocks ({bq},{bk})")
+                                 heads_per_step=hp,
+                                 label=f"blocks ({bq},{bk})x{hp}")
         except Exception as e:
-            print(f"blocks ({bq},{bk}): FAIL {repr(e)[:80]}", flush=True)
+            print(f"blocks ({bq},{bk})x{hp}: FAIL {repr(e)[:80]}",
+                  flush=True)
+
+
+def _parse_key_attrs(key):
+    """Invert tune.make_key: 'op|k=v,...' → (op, {k: int|bool|str})."""
+    op, rest = key.split("|", 1)
+    attrs = {}
+    for kv in rest.split(","):
+        k, v = kv.split("=", 1)
+        if k in ("causal", "seg"):
+            attrs[k] = v == "1"
+        elif v.lstrip("-").isdigit():
+            attrs[k] = int(v)
+        else:
+            attrs[k] = v
+    return op, attrs
+
+
+def _check_committed(committed):
+    """Re-sweep EVERY committed default for this device kind (the keys
+    themselves name the shapes) and return the list of drifted
+    entries — so the CI guard can never silently skip a stale entry."""
+    from apex_tpu.tune import search
+
+    drift = []
+    for key, entry in sorted(committed.items()):
+        op, a = _parse_key_attrs(key)
+        want = entry.get("config")
+        try:
+            if op == "flash_sdpa":
+                if a.get("bias", "none") != "none" or a["sq"] != a["sk"]:
+                    print(f"  --check: cannot sweep {key} (unsupported "
+                          "key shape); skipping", flush=True)
+                    continue
+                print(f"--- check {key}", flush=True)
+                best, _ = search.tune_flash(
+                    a["b"], a["h"], a["sq"], a["d"], dtype=a["dtype"],
+                    causal=a["causal"], seg=a["seg"], write=False,
+                    verbose=True)
+            elif op == "opt_flat":
+                print(f"--- check {key}", flush=True)
+                best, _ = search.tune_opt_flat(
+                    a["rows"] * 128, kernel=a["kernel"], write=False)
+            else:
+                print(f"  --check: unknown op in {key}; skipping",
+                      flush=True)
+                continue
+        except Exception as e:
+            drift.append((key, want, f"SWEEP FAILED: {repr(e)[:80]}"))
+            continue
+        if best != want:
+            drift.append((key, want, best))
+            print(f"  DRIFT: committed {want} != fresh {best}",
+                  flush=True)
+        else:
+            print(f"  ok: {want}", flush=True)
+    return drift
+
+
+def tune_mode(targets, check=False):
+    """Autotune (or --check) the flash + flat-Adam configs at the bench
+    shapes, then re-emit the roofline tables from the tuned cache.
+    --check re-sweeps every committed default for this device kind and
+    exits nonzero on any drift."""
+    from apex_tpu import tune
+    from apex_tpu.tune import defaults as tune_defaults
+    from apex_tpu.tune import search
+
+    kind = tune.device_kind()
+    if check:
+        committed = tune_defaults.DEFAULTS.get(kind, {})
+        if not committed:
+            print(f"tune --check: no committed defaults for device "
+                  f"kind {kind!r} — nothing to verify", flush=True)
+            return 0
+        drift = _check_committed(committed)
+        if drift:
+            print(f"tune --check: {len(drift)} committed default(s) "
+                  "drifted — update apex_tpu/tune/defaults.py:",
+                  flush=True)
+            for key, want, got in drift:
+                print(f"  {key}: committed {want} -> fresh {got}",
+                      flush=True)
+            return 1
+        print("tune --check: all committed defaults match fresh sweeps",
+              flush=True)
+        return 0
+    for t in targets:
+        nm, h, L, H, b, s, v, c = CONFIGS[t]
+        d = h // H
+        print(f"--- tune flash @ {nm}: b{b} H{H} s{s} d{d} causal={c}",
+              flush=True)
+        best, results = search.tune_flash(b, H, s, d, causal=c,
+                                          write=True, verbose=True)
+        print(f"  winner: {best} ({results[0][1]*1e3:.3f} ms)",
+              flush=True)
+    # flat-Adam block at the 1B bench point rides along
+    try:
+        best, _ = search.tune_opt_flat(10 ** 9, write=True)
+        print(f"--- tune opt_flat @ 1B: winner {best}", flush=True)
+    except Exception as e:
+        print(f"--- tune opt_flat: FAIL {repr(e)[:80]}", flush=True)
+    print(f"\ncache written to {tune.cache_path()} "
+          f"(fingerprint {tune.fingerprint()}); tuned rooflines:",
+          flush=True)
+    for t in targets:
+        nm, h, L, H, b, s, v, c = CONFIGS[t]
+        gemm_roofline(nm, h, L, H, b, s, vocab=v, causal=c)
+    return 0
 
 
 CONFIGS = {
     # name: (hidden, layers, heads, batch, seq, vocab, causal)
     "350m": ("GPT-350M", 1024, 24, 16, 12, 1024, 50304, True),
     "1p3b": ("GPT-1.3B", 2048, 24, 32, 7, 512, 50304, True),
+    # the seq-2048 1.3B attention shape — the d=64 plateau point ISSUE 3
+    # targets (batch 4 keeps activations on one chip)
+    "1p3b2k": ("GPT-1.3B-s2048", 2048, 24, 32, 4, 2048, 50304, True),
     "bert": ("BERT-Large", 1024, 24, 16, 32, 512, 30528, False),
 }
 
@@ -310,7 +445,7 @@ CONFIGS = {
 if __name__ == "__main__":
     which = sys.argv[1] if len(sys.argv) > 1 else "both"
     if which == "roofline":
-        targets = sys.argv[2:] or list(CONFIGS)
+        targets = sys.argv[2:] or [t for t in CONFIGS if t != "1p3b2k"]
         bad = [t for t in targets if t not in CONFIGS]
         if bad:
             sys.exit(f"unknown roofline target(s) {bad}; "
@@ -318,9 +453,19 @@ if __name__ == "__main__":
         for t in targets:
             nm, h, L, H, b, s, v, c = CONFIGS[t]
             gemm_roofline(nm, h, L, H, b, s, vocab=v, causal=c)
+    elif which == "tune":
+        rest = sys.argv[2:]
+        check = "--check" in rest
+        targets = [t for t in rest if t != "--check"] or list(CONFIGS)
+        bad = [t for t in targets if t not in CONFIGS]
+        if bad:
+            sys.exit(f"unknown tune target(s) {bad}; "
+                     f"choices: {sorted(CONFIGS)}")
+        sys.exit(tune_mode(targets, check=check))
     elif which == "blocks":
         flash_block_sweep(causal=False)   # BERT shape
         flash_block_sweep(batch=7, heads=32, seq=512, causal=True)  # 1.3B
+        flash_block_sweep(batch=4, heads=32, seq=2048, causal=True)  # 2k
     elif which == "both":
         for t in ("350m", "1p3b"):
             nm, h, L, H, b, s, v, c = CONFIGS[t]
@@ -331,4 +476,4 @@ if __name__ == "__main__":
     else:
         sys.exit(f"unknown mode {which!r}; expected one of "
                  f"{sorted(CONFIGS)} | both | roofline [target...] | "
-                 "blocks")
+                 "blocks | tune [--check] [target...]")

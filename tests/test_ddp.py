@@ -140,3 +140,148 @@ def test_larc_clip_mode():
     expect = 2.0 - 0.1 * (0.04 / 0.1) * 1.0
     np.testing.assert_allclose(np.asarray(new_params["w"]), expect,
                                rtol=1e-5)
+
+
+def test_make_train_step_zero2_matches_fused_adam():
+    """ISSUE 3 satellite: DistributedFusedAdam wired through
+    ddp.make_train_step on a 2-shard dp mesh must train identically to
+    single-device full-batch FusedAdam — for both n_buckets=1 and the
+    backward-overlap n_buckets=2 layout."""
+    from apex_tpu.optimizers import flat as F
+    from apex_tpu.optimizers.distributed_fused_adam import (
+        DistributedFusedAdam,
+    )
+    from apex_tpu.optimizers.fused_adam import FusedAdam
+
+    mesh = M.initialize_model_parallel(devices=jax.devices()[:2])  # dp=2
+    w_true = jnp.array([[2.0], [-3.0]])
+    X = jnp.asarray(np.random.default_rng(7).normal(size=(32, 2)),
+                    jnp.float32)
+    Y = X @ w_true
+
+    def loss_fn(params, batch):
+        x, y = batch
+        return jnp.mean((x @ params["w"] + params["b"] - y) ** 2)
+
+    params0 = {"w": jnp.zeros((2, 1)), "b": jnp.zeros((1,))}
+
+    # single-device full-batch FusedAdam reference
+    ref_opt = FusedAdam(lr=1e-2, weight_decay=0.01, use_pallas=False)
+    ref_state = ref_opt.init(params0)
+    losses_ref = []
+    for _ in range(5):
+        p = F.unflatten(ref_state.params, ref_opt.spec)
+        losses_ref.append(float(loss_fn(p, (X, Y))))
+        g = jax.grad(loss_fn)(p, (X, Y))
+        _, ref_state = ref_opt.step(ref_state, g)
+    p_ref = F.unflatten(ref_state.params, ref_opt.spec)
+
+    for nb in (1, 2):
+        opt = DistributedFusedAdam(num_shards=2, lr=1e-2,
+                                   weight_decay=0.01, use_pallas=False,
+                                   n_buckets=nb)
+        sspec = opt.state_partition_specs()
+        state = jax.jit(shard_map(opt.init, mesh=mesh, in_specs=(P(),),
+                                  out_specs=sspec,
+                                  check_vma=False))(params0)
+        step = ddp.make_train_step(loss_fn, opt, mesh,
+                                   batch_spec=(P("dp"), P("dp")))
+        losses = []
+        for _ in range(5):
+            state, _, loss = step(state, None, (X, Y))
+            losses.append(float(loss))
+        gather = jax.jit(shard_map(
+            lambda s: opt.full_params(s), mesh=mesh, in_specs=(sspec,),
+            out_specs=P(), check_vma=False))
+        p_z = gather(state)
+        for leaf_z, leaf_r in zip(jax.tree_util.tree_leaves(p_z),
+                                  jax.tree_util.tree_leaves(p_ref)):
+            np.testing.assert_allclose(np.asarray(leaf_z),
+                                       np.asarray(leaf_r),
+                                       rtol=1e-5, atol=1e-6)
+        # the step's loss output is the shard-local pre-update loss;
+        # exact parity is asserted on the params above — just require
+        # the trajectory to be improving
+        assert losses[-1] < losses[0]
+        assert int(jax.device_get(state.step)) == 5
+        # each rank holds exactly 1/2 of the padded master buffer
+        from apex_tpu.ops import optimizer_kernels as K
+        assert state.params_shard.shape[0] * 2 >= K.FLAT_TILE
+
+
+def test_make_train_step_zero2_amp_overflow_skip():
+    """ZeRO path with dynamic loss scaling: an inf gradient on ONE
+    shard's microbatch must skip the update on EVERY rank (psum-OR'd
+    found_inf) and halve the scale."""
+    from apex_tpu.optimizers.distributed_fused_adam import (
+        DistributedFusedAdam,
+    )
+
+    mesh = M.initialize_model_parallel(devices=jax.devices()[:2])
+    params0 = {"w": jnp.ones((2, 1))}
+
+    def loss_fn(params, batch):
+        x, y = batch
+        return jnp.mean((x @ params["w"] - y) ** 2)
+
+    amp_state = amp.initialize(opt_level="O1", loss_scale="dynamic")
+    from apex_tpu.amp import scaler as scaler_lib
+    scaler = scaler_lib.init("dynamic", init_scale=2.0 ** 8)
+    opt = DistributedFusedAdam(num_shards=2, lr=1e-2, use_pallas=False)
+    sspec = opt.state_partition_specs()
+    state = jax.jit(shard_map(opt.init, mesh=mesh, in_specs=(P(),),
+                              out_specs=sspec, check_vma=False))(params0)
+    step = ddp.make_train_step(loss_fn, opt, mesh, amp_state=amp_state,
+                               batch_spec=(P("dp"), P("dp")))
+    # poison ONLY the second shard's half of the batch
+    X = jnp.ones((8, 2), jnp.float32).at[6, 0].set(jnp.inf)
+    Y = jnp.zeros((8, 1), jnp.float32)
+    shard0 = jax.device_get(state.params_shard)
+    state, scaler, loss = step(state, scaler, (X, Y))
+    assert int(jax.device_get(state.step)) == 0  # skipped everywhere
+    np.testing.assert_array_equal(jax.device_get(state.params_shard),
+                                  shard0)
+    assert float(jax.device_get(scaler.scale)) == 2.0 ** 7
+
+
+def test_make_train_step_zero2_metrics_norms():
+    """ZeRO-2 + metrics: param/update norms must be the exact GLOBAL
+    values (psum over shards), matching the same run under FusedAdam."""
+    from apex_tpu import monitor
+    from apex_tpu.optimizers import flat as F
+    from apex_tpu.optimizers.distributed_fused_adam import (
+        DistributedFusedAdam,
+    )
+    from apex_tpu.optimizers.fused_adam import FusedAdam
+
+    mesh = M.initialize_model_parallel(devices=jax.devices()[:2])
+    X = jnp.asarray(np.random.default_rng(11).normal(size=(8, 2)),
+                    jnp.float32)
+    Y = X @ jnp.array([[1.5], [-0.5]])
+
+    def loss_fn(params, batch):
+        x, y = batch
+        return jnp.mean((x @ params["w"] - y) ** 2)
+
+    params0 = {"w": jnp.full((2, 1), 0.25)}
+
+    opt = DistributedFusedAdam(num_shards=2, lr=1e-2, use_pallas=False)
+    sspec = opt.state_partition_specs()
+    state = jax.jit(shard_map(opt.init, mesh=mesh, in_specs=(P(),),
+                              out_specs=sspec, check_vma=False))(params0)
+    step = ddp.make_train_step(loss_fn, opt, mesh, metrics=True,
+                               batch_spec=(P("dp"), P("dp")))
+    m = monitor.init_metrics()
+    state, _, loss, m = step(state, None, (X, Y), m)
+    pn = float(jax.device_get(m.param_norm))
+    un = float(jax.device_get(m.update_norm))
+    assert pn > 0 and un > 0  # were silently 0.0 pre-fix
+
+    ref = FusedAdam(lr=1e-2, use_pallas=False)
+    rstate = ref.init(params0)
+    g = jax.grad(loss_fn)(F.unflatten(rstate.params, ref.spec), (X, Y))
+    _, rnew = ref.step(rstate, g)
+    pn_ref = float(jnp.linalg.norm(rstate.params))
+    un_ref = float(jnp.linalg.norm(rnew.params - rstate.params))
+    np.testing.assert_allclose(pn, pn_ref, rtol=1e-5)
+    np.testing.assert_allclose(un, un_ref, rtol=1e-4)

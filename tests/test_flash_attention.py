@@ -352,7 +352,10 @@ def test_flash_in_kernel_dropout_mask_consistency():
     vv = jax.random.normal(jax.random.PRNGKey(2), (B, H, S, D))
     cc = jax.random.normal(jax.random.PRNGKey(3), (B, H, S, D))
     seed = jnp.asarray([[777]], jnp.int32)
-    args = (None, None, None, 0.18, True, 0.2, None, None, False, seed)
+    # (bias, q_seg, kv_seg, scale, causal, rate, block_q, block_k,
+    #  heads_per_step, bias_grad, seed)
+    args = (None, None, None, 0.18, True, 0.2, None, None, 1, False,
+            seed)
     o1 = np.asarray(_flash(qq, kk, vv, *args))
     o2 = np.asarray(_flash(qq, kk, vv, *args))
     np.testing.assert_array_equal(o1, o2)
@@ -368,6 +371,6 @@ def test_flash_in_kernel_dropout_mask_consistency():
 
     # keep-rate statistic ~ 1 - rate
     p_nodrop = np.asarray(_flash(
-        qq, kk, vv, None, None, None, 0.18, True, 0.0, None, None,
+        qq, kk, vv, None, None, None, 0.18, True, 0.0, None, None, 1,
         False, seed))
     assert not np.allclose(o1, p_nodrop)
