@@ -30,6 +30,10 @@ class FP16_Optimizer:
         self.scaler_state = scaler_lib.init(
             "dynamic" if dynamic_loss_scale else static_loss_scale)
         self.clip_grad_norm_value = None
+        # flight-recorder provenance (ISSUE 4): the last step's tap
+        # snapshot, so an overflow skip can say WHICH tap tripped
+        self._last_tap_state = None
+        self._last_tap_names = None
 
     @property
     def loss_scale(self):
@@ -44,7 +48,8 @@ class FP16_Optimizer:
         return scaler_lib.scale_loss(self.scaler_state, loss)
 
     def step(self, state, grads, lr=None, max_grad_norm=None,
-             metrics=None, metrics_count_step: bool = True):
+             metrics=None, metrics_count_step: bool = True,
+             tap_state=None, tap_names=None):
         """Unscale, (optionally clip), masked step, update scaler.
         Returns (params, state) — or (params, state, new_metrics) when
         a `monitor.MetricsState` is passed: loss scale, the unscaled
@@ -54,7 +59,17 @@ class FP16_Optimizer:
         metrics_count_step=False when another hook (e.g.
         forward_backward_no_pipelining) already counts this iteration's
         step — otherwise each iteration advances `step` twice and every
-        derived rate halves."""
+        derived rate halves.
+
+        tap_state / tap_names: the iteration's `monitor.trace.TapState`
+        + tap labels (from the tapped backward that produced `grads`).
+        The facade keeps them so an overflow skip is attributable:
+        `overflow_provenance()` names the tap that tripped instead of
+        only the global found_inf flag.  Device arrays are held as-is —
+        no sync unless provenance is actually asked for."""
+        self._last_tap_state = tap_state
+        if tap_names is not None:
+            self._last_tap_names = tuple(tap_names)
         scale_used = self.scaler_state.scale
         grads, found_inf = scaler_lib.unscale(self.scaler_state, grads)
         # telemetry wants the PRE-clip norm: a clipped norm pins at the
@@ -76,6 +91,19 @@ class FP16_Optimizer:
             loss_scale=scale_used, found_inf=found_inf,
             count_step=metrics_count_step)
         return params, new_state, new_metrics
+
+    def overflow_provenance(self):
+        """Which tap tripped on the last step (None when the last step
+        carried no tap state or both planes were clean).  One
+        device_get; returns `monitor.trace.provenance`'s dict:
+        {"plane", "tap", "index", "stats"} — for a loss-scaling
+        overflow the gradient plane names the tap nearest the loss
+        where the non-finite values entered backward."""
+        if self._last_tap_state is None:
+            return None
+        from apex_tpu.monitor.trace import taps as _trc
+        return _trc.provenance(self._last_tap_state,
+                               self._last_tap_names or ())
 
     # -- checkpoint parity (fp16_optimizer.py state_dict incl. masters) --
     def state_dict(self, state):

@@ -21,6 +21,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+from apex_tpu.ops._common import tap as _tap
 from apex_tpu.ops.layer_norm import fused_layer_norm
 from apex_tpu.ops.softmax import scaled_masked_softmax
 from apex_tpu.parallel.mesh import TP_AXIS
@@ -188,14 +189,19 @@ class Bert:
                              params["embed_ln"]["bias"])
         if pad_mask is None:
             pad_mask = jnp.zeros(tokens.shape, bool)
+        # `_tap` points mirror GPT._block (flight-recorder stat taps):
+        # identity no-ops unless a TapContext is active
         for i, mods in enumerate(self.blocks):
             bp = params[f"block{i}"]
             hn = fused_layer_norm(h, bp["ln1"]["weight"], bp["ln1"]["bias"])
-            h = h + self._attention(bp, mods[0], mods[1], hn, pad_mask)
+            hn = _tap(hn, f"block{i}/ln1")
+            h = h + _tap(self._attention(bp, mods[0], mods[1], hn, pad_mask),
+                         f"block{i}/attn")
             hn = fused_layer_norm(h, bp["ln2"]["weight"], bp["ln2"]["bias"])
+            hn = _tap(hn, f"block{i}/ln2")
             m = mods[2].apply(bp["fc1"], hn)
             m = jax.nn.gelu(m, approximate=True)
-            h = h + mods[3].apply(bp["fc2"], m)
+            h = h + _tap(mods[3].apply(bp["fc2"], m), f"block{i}/mlp")
         return h
 
     def loss(self, params, tokens, mlm_labels, loss_mask,

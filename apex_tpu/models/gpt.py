@@ -30,6 +30,7 @@ import jax.numpy as jnp
 from jax.ad_checkpoint import checkpoint_name
 from jax.sharding import PartitionSpec as P
 
+from apex_tpu.ops._common import tap as _tap
 from apex_tpu.ops.layer_norm import fused_layer_norm
 from apex_tpu.ops.softmax import scaled_upper_triang_masked_softmax
 from apex_tpu.parallel.collectives import (
@@ -237,21 +238,27 @@ class GPT:
         return proj_mod.apply(block_params["proj"], ctx)
 
     def _block(self, i, params, x, key):
+        # `_tap` points (flight-recorder stat taps, monitor.trace): the
+        # per-block ln/attn/mlp outputs, identity no-ops unless a
+        # TapContext is active (ops._common.tap) — untapped programs
+        # compile byte-identical
         qkv_mod, proj_mod, fc1, fc2 = self.blocks[i]
         bp = params
         k1 = k2 = k3 = None
         if key is not None:
             k1, k2, k3 = jax.random.split(key, 3)
-        h = self._ln(bp["ln1"], x)
+        h = _tap(self._ln(bp["ln1"], x), f"block{i}/ln1")
         attn = self._attention(bp, qkv_mod, proj_mod, h, k1)
         attn = _cn(attn, "attn_out")
+        attn = _tap(attn, f"block{i}/attn")
         x = x + self._dropout(k2, attn)
-        h = self._ln(bp["ln2"], x)
+        h = _tap(self._ln(bp["ln2"], x), f"block{i}/ln2")
         m = fc1.apply(bp["fc1"], h)
         m = _cn(m, "ffn1")
         m = jax.nn.gelu(m, approximate=True)
         m = fc2.apply(bp["fc2"], m)
         m = _cn(m, "ffn_out")
+        m = _tap(m, f"block{i}/mlp")
         x = x + self._dropout(k3, m)
         return x
 

@@ -13,6 +13,10 @@ Three layers:
                tokens/sec, MFU from `monitor.flops` accounting)
   * profiler — `profile_capture(step_range)`: jax.profiler trace armed
                over a chosen step window
+  * trace    — the numerics flight recorder (ISSUE 4): per-layer stat
+               taps with NaN/overflow provenance, cross-rank timing +
+               straggler detection, and the crash-dump ring buffer
+               (`monitor.trace` subpackage)
 
 See docs/observability.md for the JSONL schema and recipes, and
 examples/train_with_monitor.py for the end-to-end loop.
@@ -48,4 +52,12 @@ from apex_tpu.monitor.sinks import (  # noqa: F401
     MetricSink,
     ScalarWriter,
     SummaryWriterSink,
+    sanitize_json_floats,
+)
+from apex_tpu.monitor import trace  # noqa: F401
+from apex_tpu.monitor.trace import (  # noqa: F401
+    FlightRecorder,
+    StragglerDetector,
+    TapState,
+    TraceConfig,
 )

@@ -24,7 +24,12 @@ from apex_tpu.monitor import flops as flops_lib
 from apex_tpu.monitor.metrics import MetricsState
 from apex_tpu.monitor.sinks import MetricSink, ScalarWriter
 
-SCHEMA_VERSION = 1
+# v2 (ISSUE 4): JSONLSink serializes non-finite floats as null + a
+# "<key>_nonfinite" marker (valid JSON, enforced with allow_nan=False)
+# and tap-enabled loggers stamp the tap_* summary fields — same
+# required fields as v1, but v1 readers would mis-parse an overflow
+# record, so the version moves.
+SCHEMA_VERSION = 2
 
 # field -> (python type, finite_required).  loss_scale may legitimately
 # be large but is finite; grad/update norms are inf/nan ON overflow
@@ -61,6 +66,13 @@ def validate_record(record: dict, prev_step: Optional[int] = None) -> None:
         if name not in record:
             raise ValueError(f"missing field {name!r}")
         v = record[name]
+        if (v is None and typ is float
+                and isinstance(record.get(f"{name}_nonfinite"), str)):
+            # JSONLSink round-trip of a non-finite float: null + marker
+            # (sinks.sanitize_json_floats).  Reconstruct the value so
+            # the finiteness rules below still apply — a null grad_norm
+            # on a non-overflow window must keep failing.
+            v = float(record[f"{name}_nonfinite"])
         if typ is float and isinstance(v, int) and not isinstance(v, bool):
             v = float(v)  # JSON round-trips 1.0 as 1
         if not isinstance(v, typ) or isinstance(v, bool):
@@ -100,10 +112,18 @@ class MetricsLogger:
     def __init__(self, sinks: Sequence[MetricSink], *,
                  flops_per_step: Optional[float] = None,
                  peak_flops: float = flops_lib.V5E_BF16_PEAK,
-                 log_tuner: bool = True):
+                 log_tuner: bool = True,
+                 taps: bool = False):
         self.sinks = list(sinks)
         self.flops_per_step = flops_per_step
         self.peak_flops = peak_flops
+        # taps=True: log_step(…, taps=tap_state) folds the flight
+        # recorder's per-layer stat planes into each record as compact
+        # summary fields (tap_fwd_absmax / tap_grad_absmax /
+        # tap_nonfinite / tap_first_bad) — extra keys, schema-legal —
+        # so divergence onset is visible in the SAME JSONL stream the
+        # run already ships (ISSUE 4)
+        self.taps = taps
         # stamp the active kernel-autotuner config fingerprint into
         # every record (ISSUE 3): two trajectories with different
         # fingerprints ran different tuned kernels.  Extra keys are
@@ -130,9 +150,16 @@ class MetricsLogger:
             self._last_overflows = int(m.overflow_count)
 
     def log_step(self, metrics: MetricsState, extra: Optional[dict] = None,
+                 taps=None, tap_names: Optional[Sequence[str]] = None,
                  ) -> dict:
         """device_get the pytree, derive rates over the window since the
-        previous log_step, write to all sinks, return the record."""
+        previous log_step, write to all sinks, return the record.
+
+        taps / tap_names (with `MetricsLogger(taps=True)`): the step's
+        `monitor.trace.TapState` + ordered labels; the record gains the
+        tap_* summary fields (worst forward/gradient absmax across all
+        taps, total non-finite element count, and the first-bad tap
+        name — "" when clean)."""
         m = jax.device_get(metrics)
         now = time.perf_counter()
         step = int(m.step)
@@ -167,6 +194,8 @@ class MetricsLogger:
                 record["tuner_misses"] = t["misses"]
             except Exception:  # pragma: no cover — never break logging
                 pass
+        if self.taps and taps is not None:
+            record.update(self._tap_summary(taps, tap_names))
         if extra:
             record.update(extra)
         for s in self.sinks:
@@ -176,6 +205,37 @@ class MetricsLogger:
         self._last_tokens = float(m.tokens_seen)
         self._last_overflows = overflows
         return record
+
+    @staticmethod
+    def _tap_summary(taps, tap_names: Optional[Sequence[str]]) -> dict:
+        """Compress a TapState into flat record fields: the worst
+        per-plane absmax over all taps and the non-finite provenance.
+        One device_get of a (2n, 4)-ish pytree — same cost class as
+        the metrics fetch this call already pays."""
+        st = jax.device_get(taps)
+        names = list(tap_names or [])
+
+        def worst(plane):
+            vals = [float(v) for v in plane[:, 0]]
+            finite = [v for v in vals if math.isfinite(v)]
+            # a non-finite absmax IS the signal — report inf, not the
+            # max of the surviving finite taps
+            return max(vals, default=0.0) if len(finite) == len(vals) \
+                else float("inf")
+
+        n_bad = float(st.fwd[:, 3].sum() + st.grad[:, 3].sum()) \
+            if st.fwd.size else 0.0
+        first_bad = ""
+        for idx in (int(st.first_bad_fwd), int(st.first_bad_grad)):
+            if 0 <= idx < len(names):
+                first_bad = names[idx]
+                break
+        return {
+            "tap_fwd_absmax": worst(st.fwd) if st.fwd.size else 0.0,
+            "tap_grad_absmax": worst(st.grad) if st.grad.size else 0.0,
+            "tap_nonfinite": n_bad,
+            "tap_first_bad": first_bad,
+        }
 
     def close(self) -> None:
         for s in self.sinks:

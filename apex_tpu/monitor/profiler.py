@@ -31,6 +31,17 @@ class ProfileCapture:
                  logdir: str = "/tmp/apex_tpu_trace",
                  annotation: str = "train-step"):
         steps = sorted(set(int(s) for s in step_range))
+        # one capture = ONE contiguous trace window [first, last] —
+        # start_trace fires entering `first`, stop_trace after `last`.
+        # A gapped range (e.g. {3, 10}) used to be silently treated as
+        # its hull, capturing steps the caller never asked for; honor
+        # the contract by refusing it instead (two windows = two
+        # ProfileCapture objects)
+        if steps and steps[-1] - steps[0] != len(steps) - 1:
+            raise ValueError(
+                f"profile step_range must be contiguous, got {steps}; "
+                "a capture arms a single [first, last] trace window — "
+                "use one ProfileCapture per window")
         self._first = steps[0] if steps else None
         self._last = steps[-1] if steps else None
         self.logdir = logdir

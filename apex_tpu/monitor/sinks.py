@@ -18,8 +18,39 @@ which expects a `SummaryWriter`, can target the monitor stack directly.
 from __future__ import annotations
 
 import json
+import math
 import os
 from typing import Callable, Optional
+
+
+def sanitize_json_floats(obj):
+    """Replace non-finite floats so the result serializes as VALID JSON
+    (`json.dumps` defaults to allow_nan=True and emits bare `NaN` /
+    `Infinity` tokens — not JSON; they break every schema-validating
+    reader downstream, bench.py and the tests included).
+
+    Dict values become `None` plus a `"<key>_nonfinite"` marker holding
+    "nan" / "inf" / "-inf" (so the record stays self-describing);
+    list/tuple elements become the marker string directly (a list slot
+    cannot carry a sibling key).  Finite values pass through untouched;
+    nested dicts/lists are handled recursively.
+    """
+    def marker(v):
+        return "nan" if math.isnan(v) else ("inf" if v > 0 else "-inf")
+
+    if isinstance(obj, dict):
+        out = {}
+        for k, v in obj.items():
+            if isinstance(v, float) and not math.isfinite(v):
+                out[k] = None
+                out[f"{k}_nonfinite"] = marker(v)
+            else:
+                out[k] = sanitize_json_floats(v)
+        return out
+    if isinstance(obj, (list, tuple)):
+        return [marker(v) if isinstance(v, float) and not math.isfinite(v)
+                else sanitize_json_floats(v) for v in obj]
+    return obj
 
 
 class MetricSink:
@@ -56,7 +87,12 @@ class JSONLSink(MetricSink):
         self._f = open(self.path, mode)
 
     def write(self, record: dict) -> None:
-        self._f.write(json.dumps(record) + "\n")
+        # allow_nan=False enforces the sanitizer's contract: a NaN/Inf
+        # loss on an overflow step must serialize as null + a
+        # "<key>_nonfinite" marker, never as a bare NaN token that
+        # makes the whole line invalid JSON
+        self._f.write(json.dumps(sanitize_json_floats(record),
+                                 allow_nan=False) + "\n")
         self._f.flush()
 
     def close(self) -> None:
@@ -100,11 +136,23 @@ class SummaryWriterSink(MetricSink):
                 "a SummaryWriter-compatible object")
         self.writer = writer
         self.prefix = prefix
+        self._auto_step = 0
 
     def write(self, record: dict) -> None:
-        step = int(record.get("step", 0))
+        if "step" in record:
+            step = int(record["step"])
+            self._auto_step = step
+        else:
+            # no "step" field: tag with an internal monotonic step
+            # instead of silently piling every record onto step 0
+            self._auto_step += 1
+            step = self._auto_step
         for k, v in record.items():
-            if k == "step" or not isinstance(v, (int, float)):
+            # bool is an int subclass — without the explicit skip,
+            # flag fields (overflowed_this_window, future overflow
+            # markers) would land as 0/1 scalar curves
+            if (k == "step" or isinstance(v, bool)
+                    or not isinstance(v, (int, float))):
                 continue
             self.writer.add_scalar(self.prefix + k, v, step)
 

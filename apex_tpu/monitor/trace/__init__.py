@@ -1,0 +1,52 @@
+"""apex_tpu.monitor.trace — the numerics flight recorder (ISSUE 4).
+
+PR 2's `monitor` answers "how fast / how healthy" per step; this
+subpackage answers "WHERE did it go wrong" with three capture planes
+that all ride inside the jitted step (zero host syncs, no per-tap
+collectives — the MetricsState discipline):
+
+  * taps      — `TapState`: per-layer [absmax, mean, rms, nonfinite]
+                for forward activations AND their gradients at named
+                tap points (`ops._common.tap`, threaded through
+                models/gpt.py + models/bert.py), plus on-device
+                first-nonfinite provenance indices.  Compiled out
+                entirely when disabled.
+  * timing    — `gather_rank_timings`: one all_gather of a tiny
+                per-rank duration vector per step; the host-side
+                `StragglerDetector` turns the history into max/median
+                skew and persistent-outlier flags.
+  * recorder  — `FlightRecorder`: bounded ring of the last N steps'
+                planes (kept on device until needed) that dumps ONE
+                JSON report on exception / explicit dump();
+                `render_report` / scripts/flight_report.py print the
+                last-good → first-bad timeline.
+
+See docs/observability.md ("Debugging a divergence") for the recipes.
+"""
+
+from apex_tpu.monitor.trace.recorder import (  # noqa: F401
+    FLIGHT_RECORDER_VERSION,
+    FlightRecorder,
+)
+from apex_tpu.monitor.trace.report import (  # noqa: F401
+    render_report,
+    validate_report,
+)
+from apex_tpu.monitor.trace.straggler import StragglerDetector  # noqa: F401
+from apex_tpu.monitor.trace.taps import (  # noqa: F401
+    TAP_PLANES,
+    TAP_STAT_DIM,
+    TAP_STAT_FIELDS,
+    TIMING_FIELDS,
+    TapContext,
+    TapState,
+    TraceConfig,
+    finalize,
+    gather_rank_timings,
+    make_probes,
+    provenance,
+    tap,
+    tap_context,
+    tap_stats,
+    taps_to_dict,
+)

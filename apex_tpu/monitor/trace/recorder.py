@@ -1,0 +1,166 @@
+"""`FlightRecorder` — the bounded host-side ring buffer + crash dump.
+
+The device planes (MetricsState, TapState, gathered rank timings) ride
+inside the jitted step; the recorder holds the last N steps of them ON
+DEVICE (tiny pytrees — a few hundred scalars per step) and only
+device_gets when a report is actually dumped — recording a step never
+blocks on the step just dispatched (the straggler fold fetches only
+the PREVIOUS, already-materialized timing matrix; see record()).  On an exception inside
+`guard()` — or an explicit `dump()` from a SIGTERM handler — the ring
+is fetched and written as ONE self-contained JSON report that
+`monitor.trace.report` (or `scripts/flight_report.py`) renders into
+the last-good → first-bad timeline.
+
+Report schema (validated by `report.validate_report`; bump
+FLIGHT_RECORDER_VERSION on any field add/rename/re-semantics):
+
+    {"flight_recorder_version": 1,
+     "monitor_schema_version":  <logger.SCHEMA_VERSION>,
+     "reason": "exception: ..." | "explicit" | ...,
+     "capacity": N, "tap_names": [...], "timing_fields": [...],
+     "straggler": {...} | null,          # StragglerDetector.summary()
+     "records": [{"step": int,
+                  "metrics": {...} | null,   # flat MetricsLogger record
+                  "taps": {...} | null,      # taps.taps_to_dict shape
+                  "timings": {"per_rank": [[...], ...]} | null}]}
+
+Non-finite floats (an overflow step's absmax is ±inf by construction)
+are serialized through `sinks.sanitize_json_floats` — the report is
+always parseable JSON, which is the entire point of a crash artifact.
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import json
+import os
+from typing import Optional, Sequence
+
+import jax
+
+from apex_tpu.monitor.sinks import sanitize_json_floats
+from apex_tpu.monitor.trace import taps as taps_lib
+from apex_tpu.monitor.trace.straggler import StragglerDetector
+
+FLIGHT_RECORDER_VERSION = 1
+
+
+class FlightRecorder:
+    """Ring buffer of the last `capacity` steps' telemetry planes.
+
+    path: where `dump()` writes the JSON report.  tap_names: ordered
+    tap labels (usually `step.tap_names()` after the first call — pass
+    later via `record(tap_names=...)` if unknown at construction).
+    straggler: an optional StragglerDetector fed each step's gathered
+    timings (its summary lands in the report).
+    """
+
+    def __init__(self, path, capacity: int = 64,
+                 tap_names: Optional[Sequence[str]] = None,
+                 timing_fields: Sequence[str] = taps_lib.TIMING_FIELDS,
+                 straggler: Optional[StragglerDetector] = None):
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self.path = os.fspath(path)
+        self.capacity = capacity
+        self.tap_names = list(tap_names) if tap_names is not None else None
+        self.timing_fields = list(timing_fields)
+        self.straggler = straggler
+        self._ring = collections.deque(maxlen=capacity)
+        # timing matrices awaiting the straggler fold (at most one —
+        # see record(): the newest step's output may still be in
+        # flight, so its device_get is deferred one call)
+        self._pending_timings = collections.deque()
+
+    def __len__(self) -> int:
+        return len(self._ring)
+
+    def record(self, step: int, *, metrics: Optional[dict] = None,
+               taps=None, timings=None,
+               tap_names: Optional[Sequence[str]] = None) -> None:
+        """Append one step.  metrics: the flat host record
+        `MetricsLogger.log_step` returned (already fetched).  taps: the
+        step's TapState (kept as DEVICE arrays until dump).  timings:
+        the gathered (n_ranks, k) matrix (device or host).
+
+        An attached StragglerDetector needs EVERY step in order (its
+        consecutive-outlier counts cannot be reconstructed from the
+        bounded ring at dump time), but fetching the newest step's
+        output here would block on the step that was just dispatched.
+        So the fold is deferred one call: step N's matrix is
+        device_get when step N+1 is recorded — by then it is
+        materialized and the fetch is free — and `report()` drains the
+        last one."""
+        if tap_names is not None and self.tap_names is None:
+            self.tap_names = list(tap_names)
+        if timings is not None and self.straggler is not None:
+            self._pending_timings.append(timings)
+            while len(self._pending_timings) > 1:
+                self.straggler.update(
+                    jax.device_get(self._pending_timings.popleft()))
+        self._ring.append(
+            {"step": int(step), "metrics": metrics, "taps": taps,
+             "timings": timings})
+
+    def report(self, reason: str = "explicit") -> dict:
+        """Materialize the report dict (device_gets the ring)."""
+        while self._pending_timings:  # the deferred straggler fold
+            try:
+                self.straggler.update(
+                    jax.device_get(self._pending_timings.popleft()))
+            except Exception:  # a poisoned buffer must not cost the
+                pass           # whole report
+        records = []
+        for entry in self._ring:
+            rec = {"step": entry["step"], "metrics": entry["metrics"],
+                   "taps": None, "timings": None}
+            try:
+                if entry["taps"] is not None:
+                    rec["taps"] = taps_lib.taps_to_dict(
+                        entry["taps"], self.tap_names or [])
+                if entry["timings"] is not None:
+                    t = jax.device_get(entry["timings"])
+                    rec["timings"] = {
+                        "per_rank": [[float(v) for v in row]
+                                     for row in t]}
+            except Exception as e:  # a poisoned device buffer must not
+                rec["fetch_error"] = repr(e)  # cost us the whole report
+            records.append(rec)
+        from apex_tpu.monitor import logger as logger_lib
+        return {
+            "flight_recorder_version": FLIGHT_RECORDER_VERSION,
+            "monitor_schema_version": logger_lib.SCHEMA_VERSION,
+            "reason": reason,
+            "capacity": self.capacity,
+            "tap_names": list(self.tap_names or []),
+            "timing_fields": list(self.timing_fields),
+            "straggler": (self.straggler.summary()
+                          if self.straggler is not None else None),
+            "records": records,
+        }
+
+    def dump(self, reason: str = "explicit") -> dict:
+        """Write the report to `self.path` (atomic: tmp + rename — a
+        crash artifact that is itself truncated is worse than none) and
+        return it."""
+        rep = sanitize_json_floats(self.report(reason))
+        d = os.path.dirname(self.path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        tmp = self.path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(rep, f, indent=1, allow_nan=False)
+            f.write("\n")
+        os.replace(tmp, self.path)
+        return rep
+
+    @contextlib.contextmanager
+    def guard(self):
+        """Wrap the training loop: any exception dumps the report
+        (reason = the exception repr) and re-raises."""
+        try:
+            yield self
+        except BaseException as e:
+            self.dump(reason=f"exception: {e!r}")
+            raise

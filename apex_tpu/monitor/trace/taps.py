@@ -1,0 +1,184 @@
+"""Device side of the numerics flight recorder: the `TapState` pytree.
+
+Same design discipline as `MetricsState` (metrics.py module docstring):
+everything is computed INSIDE the jitted step from values it already
+holds, with zero host syncs and zero collectives per tap.  The tap op
+itself lives in `ops._common` (the models call it on their hot path and
+must not import the monitor package); this module owns the pytree the
+hot paths return and the host-side interpretation helpers.
+
+How stats get out of AD: each `tap(x, name)` draws a zeros (2, 4) row
+from a `probes` array that is an *argument* of the step's `jax.grad`;
+the tap op's custom_vjp returns `[tap_stats(x), tap_stats(cotangent)]`
+as that row's gradient.  `finalize()` slices the used rows, unscales
+the gradient plane by the loss scale, and computes the first-nonfinite
+provenance indices — all still on device.
+
+Provenance convention: the FORWARD plane reads in forward order, so
+`first_bad_fwd` is the MINIMUM tapped index with a non-finite value —
+the earliest layer where the forward went bad.  The GRADIENT plane
+flows loss→embedding, so the first tap the backward corrupted is the
+MAXIMUM index (`first_bad_grad`).  -1 = plane clean.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import NamedTuple, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.ops._common import (  # noqa: F401 — re-exported
+    TAP_PLANES,
+    TAP_STAT_DIM,
+    TAP_STAT_FIELDS,
+    TapContext,
+    active_tap_context,
+    grad_tap,
+    tap,
+    tap_context,
+    tap_stats,
+)
+
+# Columns of the cross-rank timing vector (see `gather_rank_timings`):
+# the host measures these per rank per step and the jitted step
+# all_gathers them so every rank's flight recorder sees every rank.
+TIMING_FIELDS = ("step_duration_s", "allreduce_duration_s")
+
+
+@dataclasses.dataclass(frozen=True)
+class TraceConfig:
+    """Static knobs for the flight-recorder planes a hot path collects.
+
+    taps: per-layer stat taps (TapState output).  max_taps bounds the
+    probes array (rows are tiny — (2, 4) f32 each — so a generous
+    default costs nothing; unused rows stay zero and are sliced off at
+    trace time).  rank_timing: the cross-rank timing plane — the step
+    takes a per-rank local timing vector and returns the all_gathered
+    (n_ranks, k) matrix (ONE small collective per step, no per-tap
+    collectives)."""
+
+    taps: bool = True
+    max_taps: int = 512
+    rank_timing: bool = False
+    timing_dim: int = len(TIMING_FIELDS)
+
+
+class TapState(NamedTuple):
+    """Per-step tap snapshot riding inside the jitted step.
+
+    fwd/grad: (n_taps, 4) f32 — [absmax, mean, rms, nonfinite count]
+    per tap point, forward plane and gradient plane (gradient stats are
+    unscaled when the step runs under loss scaling; the nonfinite count
+    is of the RAW scaled grads — the thing the overflow skip saw).
+    first_bad_fwd / first_bad_grad: i32 provenance indices into the tap
+    name list (-1 = clean); see module docstring for the ordering.
+    """
+
+    fwd: jnp.ndarray
+    grad: jnp.ndarray
+    first_bad_fwd: jnp.ndarray
+    first_bad_grad: jnp.ndarray
+
+
+def make_probes(max_taps: int) -> jnp.ndarray:
+    """The zeros probes array a tapped trace draws rows from."""
+    return jnp.zeros((max_taps, 2, TAP_STAT_DIM), jnp.float32)
+
+
+def _first_nonfinite(plane: jnp.ndarray, reverse: bool) -> jnp.ndarray:
+    n = plane.shape[0]
+    if n == 0:
+        return jnp.asarray(-1, jnp.int32)
+    bad = plane[:, TAP_STAT_FIELDS.index("nonfinite")] > 0
+    idx = jnp.arange(n, dtype=jnp.int32)
+    if reverse:  # gradient plane: backward hits high indices first
+        return jnp.max(jnp.where(bad, idx, -1))
+    first = jnp.min(jnp.where(bad, idx, n))
+    return jnp.where(first == n, -1, first).astype(jnp.int32)
+
+
+def finalize(probe_grads: jnp.ndarray, n_taps: int,
+             inv_scale=1.0) -> TapState:
+    """Build the TapState from jax.grad's probes cotangent.
+
+    probe_grads: (max_taps, 2, 4); n_taps: how many rows the trace used
+    (host-side int — `len(ctx.names)` after jax.grad returns).
+    inv_scale unscales the gradient plane's absmax/mean/rms so reported
+    magnitudes are comparable across loss-scale changes; the nonfinite
+    count is left as observed on the raw scaled grads."""
+    used = probe_grads[:n_taps]
+    fwd = used[:, 0]
+    unscale = jnp.asarray(
+        [inv_scale, inv_scale, inv_scale, 1.0], jnp.float32)
+    grad = used[:, 1] * unscale
+    return TapState(
+        fwd=fwd, grad=grad,
+        first_bad_fwd=_first_nonfinite(fwd, reverse=False),
+        first_bad_grad=_first_nonfinite(used[:, 1], reverse=True))
+
+
+def gather_rank_timings(local_timing, axis_name: str) -> jnp.ndarray:
+    """The cross-rank timing plane: ONE all_gather of a tiny vector.
+
+    local_timing: this rank's (k,) f32 host-measured durations (by
+    convention `TIMING_FIELDS`).  Returns (n_ranks, k), replicated —
+    every rank's recorder sees every rank, which is the whole point:
+    on hardware reached only through committed telemetry, rank-skew
+    must ride the step itself.  Call inside shard_map/pmap."""
+    v = jnp.asarray(local_timing, jnp.float32).reshape(-1)
+    return jax.lax.all_gather(v, axis_name)
+
+
+# --------------------------- host-side helpers ---------------------------
+
+def taps_to_dict(tap_state: TapState,
+                 names: Sequence[str]) -> dict:
+    """device_get a TapState into the flight-report JSON shape:
+    {"fwd": {name: {absmax, mean, rms, nonfinite}}, "grad": {...},
+    "first_bad_fwd": name|None, "first_bad_grad": name|None}."""
+    st = jax.device_get(tap_state)
+    names = list(names)
+
+    def plane(mat):
+        return {nm: {f: float(v) for f, v in zip(TAP_STAT_FIELDS, row)}
+                for nm, row in zip(names, mat)}
+
+    def badname(i):
+        i = int(i)
+        return names[i] if 0 <= i < len(names) else None
+
+    return {
+        "fwd": plane(st.fwd),
+        "grad": plane(st.grad),
+        "first_bad_fwd": badname(st.first_bad_fwd),
+        "first_bad_grad": badname(st.first_bad_grad),
+    }
+
+
+def provenance(tap_state: TapState,
+               names: Sequence[str]) -> Optional[dict]:
+    """First-nonfinite attribution, host side (ONE device_get).
+
+    Returns None when both planes are clean.  The FORWARD plane wins
+    when it has a hit: a non-finite activation always precedes (and
+    causes) the backward corruption downstream of it, so the earliest
+    bad forward tap is the origin.  Only when the forward was clean —
+    the classic loss-scaling overflow, where fp16/bf16 grads blow up
+    in backward alone — does the gradient plane attribute: its first
+    bad tap (closest to the loss) is where the overflow entered."""
+    st = jax.device_get(tap_state)
+    names = list(names)
+    for plane_name, idx, mat in (
+            ("fwd", int(st.first_bad_fwd), st.fwd),
+            ("grad", int(st.first_bad_grad), st.grad)):
+        if 0 <= idx < len(names):
+            return {
+                "plane": plane_name,
+                "tap": names[idx],
+                "index": idx,
+                "stats": {f: float(v) for f, v in
+                          zip(TAP_STAT_FIELDS, mat[idx])},
+            }
+    return None

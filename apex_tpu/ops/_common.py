@@ -10,9 +10,12 @@ absent (apex/normalization/fused_layer_norm.py:288-294).
 
 from __future__ import annotations
 
+import contextlib
 import os
+import threading
 
 import jax
+import jax.numpy as jnp
 
 _FORCE = os.environ.get("APEX_TPU_FORCE_PALLAS", "")
 
@@ -86,6 +89,124 @@ def tuned_row_block(op: str, rows: int, hidden: int, **kw) -> int:
         if (isinstance(blk, int) and 8 <= blk <= 4096 and blk % 8 == 0):
             return blk
     return base
+
+
+# --------------------------- numerics taps ---------------------------
+#
+# The flight-recorder tap op (monitor/trace, ISSUE 4).  It lives here —
+# not in monitor/ — because the models call `tap()` on their hot path
+# and must not import the monitor package (which pulls sinks/logger);
+# ops._common is already in their import closure (dropout above).
+#
+# Contract: `tap(x, name)` is a BYTE-IDENTICAL identity when no
+# TapContext is active (the default) — it returns `x` itself before
+# tracing ever sees a new op, so untapped programs compile unchanged.
+# Under an active context every tap draws a zeros (2, 4) row from the
+# context's `probes` array and BOTH stat planes flow out through that
+# row's *gradient*: `grad_tap`'s custom_vjp saves `tap_stats(x)` as a
+# residual and returns it stacked with `tap_stats(cotangent)` as the
+# probe's cotangent.  Differentiating the loss w.r.t. `probes` then
+# yields (n_taps, 2, 4) = per-tap [fwd, grad] stats with no side
+# channels, no host callbacks, and no collectives — and because no
+# traced value ever lands in Python state, taps are safe inside
+# jax.checkpoint/remat regions and lax control flow.
+
+TAP_STAT_FIELDS = ("absmax", "mean", "rms", "nonfinite")
+TAP_STAT_DIM = len(TAP_STAT_FIELDS)
+TAP_PLANES = ("fwd", "grad")
+
+
+def tap_stats(x) -> jnp.ndarray:
+    """f32[4] = [absmax, mean, rms, nonfinite-element count] of x.
+
+    Computed in f32; when x holds non-finite values the first three
+    lanes are themselves non-finite (NaN propagates through max/mean)
+    while lane 3 — the count — is always finite and is what provenance
+    keys on."""
+    xf = x.astype(jnp.float32)
+    return jnp.stack([
+        jnp.max(jnp.abs(xf)),
+        jnp.mean(xf),
+        jnp.sqrt(jnp.mean(jnp.square(xf))),
+        jnp.sum(~jnp.isfinite(xf)).astype(jnp.float32),
+    ])
+
+
+@jax.custom_vjp
+def grad_tap(x, probe):
+    """Identity on x whose backward writes stacked
+    [tap_stats(x), tap_stats(cotangent)] into `probe`'s gradient
+    (probe: f32[2, 4] zeros drawn from TapContext)."""
+    del probe
+    return x
+
+
+def _grad_tap_fwd(x, probe):
+    del probe
+    return x, tap_stats(x)
+
+
+def _grad_tap_bwd(fwd_stats, g):
+    return g, jnp.stack([fwd_stats, tap_stats(g)])
+
+
+grad_tap.defvjp(_grad_tap_fwd, _grad_tap_bwd)
+
+
+class TapContext:
+    """Assigns probe rows to tap points for one trace.
+
+    probes: f32[max_taps, 2, 4] zeros — an ARGUMENT of the caller's
+    jax.grad so each tap's [fwd, grad] stats land in its row (see
+    grad_tap).  Rows are assigned in forward trace order; `names[i]`
+    labels row i (host-side strings, read after jax.grad returns).
+    `discover=True` records names only (no probe draw) for shape-free
+    tap enumeration."""
+
+    def __init__(self, probes=None, discover: bool = False):
+        self.probes = probes
+        self.discover = discover
+        self.names = []
+
+    @property
+    def max_taps(self) -> int:
+        return 0 if self.probes is None else int(self.probes.shape[0])
+
+
+_ACTIVE_TAPS = threading.local()
+
+
+def active_tap_context():
+    return getattr(_ACTIVE_TAPS, "ctx", None)
+
+
+@contextlib.contextmanager
+def tap_context(ctx: TapContext):
+    prev = active_tap_context()
+    _ACTIVE_TAPS.ctx = ctx
+    try:
+        yield ctx
+    finally:
+        _ACTIVE_TAPS.ctx = prev
+
+
+def tap(x, name: str):
+    """Named numerics tap point.  No active TapContext (the default):
+    returns x itself — zero cost, compiled out.  Active: arms the
+    [fwd, grad] stats probe for this point."""
+    ctx = active_tap_context()
+    if ctx is None:
+        return x
+    i = len(ctx.names)
+    ctx.names.append(str(name))
+    if ctx.discover:
+        return x
+    if i >= ctx.max_taps:
+        raise ValueError(
+            f"tap {name!r} is tap #{i + 1} but the TapContext probes "
+            f"array holds {ctx.max_taps} rows; raise "
+            "TraceConfig.max_taps")
+    return grad_tap(x, ctx.probes[i])
 
 
 def dropout(key, rate: float, x):

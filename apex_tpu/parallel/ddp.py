@@ -125,7 +125,8 @@ def make_train_step(loss_fn: Callable, optimizer, mesh, *,
                     with_state: bool = False,
                     num_microbatches: int = 1,
                     main_grad_dtype=None,
-                    metrics=None):
+                    metrics=None,
+                    trace=None):
     """Build the fused data-parallel train step.
 
     `loss_fn(params, batch) -> loss` (or `(loss, aux)` with has_aux;
@@ -179,6 +180,32 @@ def make_train_step(loss_fn: Callable, optimizer, mesh, *,
     When omitted (default) the built step is the identical program as
     before — signature, outputs, and numerics unchanged.
 
+    trace enables the numerics flight recorder (apex_tpu.monitor.trace):
+    pass True or a `trace.TraceConfig`.  With `taps` (the default
+    config), the step differentiates w.r.t. (params, tap probes) so the
+    per-layer tap stats ride out of AD functionally — the step returns
+    a `trace.TapState` as an extra trailing output (forward + gradient
+    plane stats per tap point plus on-device first-nonfinite
+    provenance; `step.tap_names()` gives the row labels after the
+    first call).  Param grads are untouched (the tap op is an identity)
+    and a loss_fn with no `tap()` calls yields an empty TapState.
+    With `rank_timing`, the step takes ONE more trailing input — the
+    (n_ranks, timing_dim) per-rank host-measured duration matrix,
+    sharded over `axis_name` — and returns its all_gather (replicated)
+    as the final output, so every rank's flight recorder sees every
+    rank's step/allreduce durations via a single tiny collective (feed
+    `trace.StragglerDetector`).  Taps currently require
+    num_microbatches == 1 (per-microbatch stat merging is not defined
+    yet); rank timing composes with everything.  As with metrics,
+    omitting trace (the default) rebuilds the byte-identical pre-trace
+    program.
+
+    Argument/output order with everything enabled:
+        step(opt_state, scaler_state[, model_state], batch,
+             metrics_state, local_timing)
+          -> (opt_state, scaler_state[, model_state], loss[, aux],
+              metrics, tap_state, rank_timings)
+
     ≡ the reference hot loop: DDP.forward → amp.scale_loss → backward
     hooks/allreduce → FusedAdam.step (SURVEY §3.2-3.3), collapsed into
     one compiled program.
@@ -207,9 +234,27 @@ def make_train_step(loss_fn: Callable, optimizer, mesh, *,
                 "MetricsConfig at build time; pass the MetricsState to "
                 "the built step as its trailing argument")
         metrics_cfg = _mon.MetricsConfig() if metrics is True else metrics
+    trace_cfg = None
+    if trace is not None and trace is not False:
+        from apex_tpu.monitor.trace import taps as _trc
+        trace_cfg = _trc.TraceConfig() if trace is True else trace
+        if trace_cfg.taps and num_microbatches != 1:
+            raise ValueError(
+                "trace taps require num_microbatches == 1 (merging "
+                "per-microbatch tap stats across the accumulation scan "
+                "is not defined); use TraceConfig(taps=False, "
+                "rank_timing=True) for the timing plane alone")
+    # host-side label side channel: the tap names are known once the
+    # tapped loss has been traced (first call); step.tap_names() reads
+    # them for the flight-recorder report
+    tap_holder = {"names": None}
 
     def local_step(opt_state, scaler_state, model_state, batch,
-                   metrics_state=None):
+                   *extras):
+        ex = list(extras)
+        metrics_state = ex.pop(0) if metrics_cfg is not None else None
+        local_timing = ex.pop(0) if (
+            trace_cfg is not None and trace_cfg.rank_timing) else None
         raw_batch = batch
         if sharded_opt:
             # ZeRO-2: all-gather full params from this rank's shard;
@@ -239,12 +284,35 @@ def make_train_step(loss_fn: Callable, optimizer, mesh, *,
                 else loss
             return scaled, (aux, loss)
 
+        probe_grads = None
         if num_microbatches == 1:
-            # nothing to accumulate: keep the single-shot path (and the
-            # bare aux return shape); main_grad_dtype only picks the
-            # dtype the grads leave backward in
-            grads, (aux, loss) = jax.grad(scaled_loss_fn, has_aux=True)(
-                params, model_state, batch)
+            if trace_cfg is not None and trace_cfg.taps:
+                # numerics taps: differentiate w.r.t. (params, probes) —
+                # the probes cotangent IS the per-tap [fwd, grad] stats
+                # (ops._common.grad_tap); param grads are untouched
+                # because the tap op is an identity on its input
+                from apex_tpu.monitor.trace import taps as _trc
+                from apex_tpu.ops import _common as _tapc
+                probes = _trc.make_probes(trace_cfg.max_taps)
+
+                def tapped_loss(p_probes, mstate, b):
+                    p, pr = p_probes
+                    ctx = _tapc.TapContext(probes=pr)
+                    with _tapc.tap_context(ctx):
+                        scaled, payload = scaled_loss_fn(p, mstate, b)
+                    tap_holder["names"] = tuple(ctx.names)
+                    return scaled, payload
+
+                (grads, probe_grads), (aux, loss) = jax.grad(
+                    tapped_loss, has_aux=True)(
+                        (params, probes), model_state, batch)
+            else:
+                # nothing to accumulate: keep the single-shot path (and
+                # the bare aux return shape); main_grad_dtype only picks
+                # the dtype the grads leave backward in
+                grads, (aux, loss) = jax.grad(
+                    scaled_loss_fn, has_aux=True)(
+                        params, model_state, batch)
             if main_grad_dtype is not None:
                 grads = jax.tree_util.tree_map(
                     lambda g: g.astype(main_grad_dtype), grads)
@@ -309,6 +377,15 @@ def make_train_step(loss_fn: Callable, optimizer, mesh, *,
             found_inf = jnp.zeros((), bool)
             new_scaler = None
 
+        tap_state = None
+        if probe_grads is not None:
+            from apex_tpu.monitor.trace import taps as _trc
+            # the gradient plane's magnitudes are unscaled here so the
+            # report reads in loss units; the nonfinite count stays as
+            # observed on the raw scaled grads (what found_inf saw)
+            tap_state = _trc.finalize(
+                probe_grads, len(tap_holder["names"]), inv_scale=inv)
+
         step_kw = {"gather_params": False} if skip_gather else {}
         new_params, new_opt_state = optimizer.step(
             opt_state, grads, inv_scale=inv, found_inf=found_inf,
@@ -357,6 +434,24 @@ def make_train_step(loss_fn: Callable, optimizer, mesh, *,
                 loss_scale=scaler_state.scale if scaler_state is not None
                 else 1.0,
                 found_inf=found_inf, tokens=tokens),)
+        if trace_cfg is not None and trace_cfg.taps:
+            outs = outs + (tap_state,)
+        if trace_cfg is not None and trace_cfg.rank_timing:
+            from apex_tpu.monitor.trace import taps as _trc
+            # ONE tiny all_gather per step — the whole cross-rank
+            # timing plane; the local (1, k) shard flattens to this
+            # rank's vector first.  Trace-time width check: a
+            # mismatched matrix would otherwise surface as an opaque
+            # downstream shape error
+            if local_timing.shape[-1] != trace_cfg.timing_dim:
+                raise ValueError(
+                    f"local_timing has {local_timing.shape[-1]} "
+                    f"columns, TraceConfig.timing_dim is "
+                    f"{trace_cfg.timing_dim}; pass a (n_ranks, "
+                    f"{trace_cfg.timing_dim}) per-rank duration matrix "
+                    "or set timing_dim to match")
+            outs = outs + (_trc.gather_rank_timings(
+                local_timing.reshape(-1), axis_name),)
         return outs
 
     # batch sharded over dp; params/opt state replicated — unless the
@@ -378,6 +473,11 @@ def make_train_step(loss_fn: Callable, optimizer, mesh, *,
     if metrics_cfg is not None:
         in_specs += (P(),)       # metrics pytree replicated
         out_specs += (P(),)
+    if trace_cfg is not None and trace_cfg.taps:
+        out_specs += (P(),)      # TapState (shard-local stats, see doc)
+    if trace_cfg is not None and trace_cfg.rank_timing:
+        in_specs += (P(axis_name),)  # (n_ranks, k) local timing rows
+        out_specs += (P(),)          # gathered matrix, replicated
 
     smapped = shard_map(
         local_step, mesh=mesh,
@@ -388,15 +488,18 @@ def make_train_step(loss_fn: Callable, optimizer, mesh, *,
     donate_args = (0,) if donate else ()
     jitted = jax.jit(smapped, donate_argnums=donate_args)
 
+    if with_state and metrics_cfg is None and trace_cfg is None:
+        return jitted  # the exact pre-metrics/pre-trace callable
+
     if with_state:
-        return jitted
-
-    if metrics_cfg is not None:
-        def step(opt_state, scaler_state, batch, metrics_state):
-            return jitted(opt_state, scaler_state, None, batch,
-                          metrics_state)
+        def step(opt_state, scaler_state, model_state, batch, *extra):
+            return jitted(opt_state, scaler_state, model_state, batch,
+                          *extra)
     else:
-        def step(opt_state, scaler_state, batch):
-            return jitted(opt_state, scaler_state, None, batch)
+        def step(opt_state, scaler_state, batch, *extra):
+            return jitted(opt_state, scaler_state, None, batch, *extra)
 
+    # flight-recorder label access: the ordered tap names, known after
+    # the tapped loss first traces (None before the first call)
+    step.tap_names = lambda: tap_holder["names"]
     return step

@@ -32,7 +32,7 @@ from jax import lax
 
 from apex_tpu.parallel.collectives import (
     reduce_from_tensor_model_parallel_region as _bcast_from_last)
-from apex_tpu.parallel.mesh import PP_AXIS
+from apex_tpu.parallel.mesh import DP_AXIS, PP_AXIS
 
 
 def spmd_pipeline(stage_fn: Callable, stage_params, microbatches, *,
@@ -214,7 +214,9 @@ def forward_backward_no_pipelining(forward_step_func, batch, model_params, *,
                                    num_microbatches: int,
                                    grad_fn: Optional[Callable] = None,
                                    main_grad_dtype=None,
-                                   metrics=None, tokens_per_step=None):
+                                   metrics=None, tokens_per_step=None,
+                                   rank_timing=None,
+                                   rank_timing_axis: str = DP_AXIS):
     """≡ fwd_bwd_no_pipelining.py:23-120: loop microbatches, average loss
     and accumulate grads (no_sync semantics are implicit — grads sync
     when the caller psums them once after this returns).
@@ -235,6 +237,15 @@ def forward_backward_no_pipelining(forward_step_func, batch, model_params, *,
     it metrics_count_step=False so the step counter advances once.
     When omitted the function is byte-for-byte the old one.
 
+    rank_timing: this rank's (k,) host-measured duration vector (by
+    convention `monitor.trace.TIMING_FIELDS` — per-phase durations the
+    driver timed around the previous iteration).  The gathered
+    (n_ranks, k) matrix is appended as the LAST return value via one
+    all_gather over `rank_timing_axis` — the cross-rank plane of the
+    numerics flight recorder (feed `trace.StragglerDetector`).  Call
+    inside shard_map with that axis bound.  Omitted (default): no
+    collective, no extra output.
+
     main_grad_dtype: None keeps the historical path — AD through the
     microbatch scan, whose cotangent carry (and therefore the
     accumulator) lives in each param's OWN dtype: with bf16 params every
@@ -249,13 +260,18 @@ def forward_backward_no_pipelining(forward_step_func, batch, model_params, *,
     docs/PERF.md (round 6).
     """
     def finish(loss, grads):
-        if metrics is None:
-            return loss, grads
-        from apex_tpu.monitor import metrics as _mon
-        tokens = tokens_per_step if tokens_per_step is not None else \
-            _mon.infer_tokens_per_step(batch, microbatch_dims=1)
-        return loss, grads, _mon.update_metrics(
-            metrics, loss=loss, grads=grads, tokens=tokens)
+        out = (loss, grads)
+        if metrics is not None:
+            from apex_tpu.monitor import metrics as _mon
+            tokens = tokens_per_step if tokens_per_step is not None else \
+                _mon.infer_tokens_per_step(batch, microbatch_dims=1)
+            out = out + (_mon.update_metrics(
+                metrics, loss=loss, grads=grads, tokens=tokens),)
+        if rank_timing is not None:
+            from apex_tpu.monitor.trace import taps as _trc
+            out = out + (_trc.gather_rank_timings(rank_timing,
+                                                  rank_timing_axis),)
+        return out
 
     if main_grad_dtype is None:
         def total_loss(p):

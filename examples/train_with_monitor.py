@@ -1,4 +1,4 @@
-"""Train the minimal GPT with full telemetry (ISSUE 2 demo).
+"""Train the minimal GPT with full telemetry (ISSUE 2 + ISSUE 4 demo).
 
 The smallest end-to-end `apex_tpu.monitor` loop: a tiny GPT trains with
 the fused data-parallel step (`ddp.make_train_step`) under dynamic loss
@@ -9,8 +9,17 @@ derived by `MetricsLogger`; phase timers land in the same stream via
 `Timers.write(names, logger.writer, step)`; `--profile-dir` arms a
 `jax.profiler` capture over steps 1-2.
 
+`--flight-report PATH` arms the numerics flight recorder (ISSUE 4):
+the step is built with `trace=True` (per-layer stat taps + cross-rank
+timing), every step lands in a bounded ring buffer, and any exception
+in the loop dumps a JSON crash report to PATH (render with
+`scripts/flight_report.py PATH`).  `--crash-at N` raises mid-loop at
+step N to exercise exactly that path (the crash-dump integrity test,
+tests/test_trace.py).
+
   python examples/train_with_monitor.py --steps 10 \\
       --jsonl /tmp/metrics.jsonl [--profile-dir /tmp/trace] \\
+      [--flight-report /tmp/flight.json [--crash-at N]] \\
       [--force-cpu-devices N]
 """
 import _bootstrap
@@ -37,6 +46,13 @@ def main():
     ap.add_argument("--jsonl", default="/tmp/train_with_monitor.jsonl")
     ap.add_argument("--profile-dir", default=None,
                     help="arm profile_capture over steps 1-2, traces here")
+    ap.add_argument("--flight-report", default=None,
+                    help="arm the numerics flight recorder; crash "
+                         "report JSON dumps here")
+    ap.add_argument("--flight-capacity", type=int, default=8,
+                    help="flight-recorder ring depth (steps)")
+    ap.add_argument("--crash-at", type=int, default=None,
+                    help="raise mid-loop at this step (crash-dump demo)")
     ap.add_argument("--force-cpu-devices", type=int, default=None,
                     help="handled by _bootstrap before jax init")
     args = ap.parse_args()
@@ -64,10 +80,14 @@ def main():
         return model.loss(p, tokens, labels)
 
     from jax.sharding import PartitionSpec as P
+    flight = args.flight_report is not None
+    trace_cfg = None
+    if flight:
+        trace_cfg = monitor.TraceConfig(taps=True, rank_timing=True)
     step = ddp.make_train_step(loss_fn, opt, mesh,
                                amp_state=amp_state,
                                batch_spec=(P("dp"), P("dp")),
-                               metrics=True)
+                               metrics=True, trace=trace_cfg)
 
     tokens_per_step = args.batch * cfg.seq_len
     # MFU convention: GLOBAL-batch FLOPs over the AGGREGATE peak of all
@@ -76,9 +96,16 @@ def main():
     logger = monitor.MetricsLogger(
         [monitor.JSONLSink(args.jsonl), monitor.ConsoleSink()],
         flops_per_step=monitor.gpt_step_flops(cfg, args.batch),
-        peak_flops=monitor.V5E_BF16_PEAK * dp)
+        peak_flops=monitor.V5E_BF16_PEAK * dp,
+        taps=flight)
     metrics = monitor.init_metrics()
     timers = Timers()
+
+    recorder = None
+    if flight:
+        recorder = monitor.FlightRecorder(
+            args.flight_report, capacity=args.flight_capacity,
+            straggler=monitor.StragglerDetector())
 
     cap = (monitor.profile_capture(range(1, 3), logdir=args.profile_dir)
            if args.profile_dir else monitor.ProfileCapture(()))
@@ -91,6 +118,27 @@ def main():
                                     cfg.vocab_size)
         return key, (tokens, jnp.roll(tokens, -1, axis=1))
 
+    import time
+
+    import numpy as np
+
+    # the flight recorder's cross-rank timing plane: the host feeds
+    # each step the PREVIOUS step's per-rank durations (this
+    # single-process demo measures one wall clock for all dp shards;
+    # multi-process launchers feed each process's own measurements)
+    def run_step(batch, metrics, timing_row):
+        if not flight:
+            return step(opt_state_box[0], scaler_box[0], batch,
+                        metrics) + (None, None)
+        local_timing = jnp.asarray(
+            np.tile(np.asarray(timing_row, np.float32), (dp, 1)))
+        return step(opt_state_box[0], scaler_box[0], batch, metrics,
+                    local_timing)
+
+    opt_state_box = [opt_state]
+    scaler_box = [scaler]
+    prev_durations = (0.0, 0.0)
+
     # two unlogged warmup steps, then restart the rate window: without
     # them the first record's step_time/tokens-per-sec/MFU measure jit
     # compilation, not training (two because the first donated-state
@@ -98,24 +146,40 @@ def main():
     # the initial inputs — same reason bench.py warms up twice)
     for _ in range(2):
         key, batch = make_batch(key)
-        opt_state, scaler, _, metrics = step(opt_state, scaler, batch,
-                                             metrics)
-    jax.block_until_ready(opt_state)
+        out = run_step(batch, metrics, prev_durations)
+        opt_state_box[0], scaler_box[0], _, metrics = out[:4]
+    jax.block_until_ready(opt_state_box[0])
     logger.reset_timer(metrics)  # resync step/token baselines too
 
-    for i in range(args.steps):
-        key, (tokens, labels) = make_batch(key)
-        with cap.step(i):
-            timers("train-step").start()
-            opt_state, scaler, loss, metrics = step(
-                opt_state, scaler, (tokens, labels), metrics)
-            timers("train-step").stop(block=True)
-        logger.log_step(metrics)
-        timers.write(["train-step"], logger.writer, i, reset=True)
+    with (recorder.guard() if flight else cap):
+        for i in range(args.steps):
+            key, (tokens, labels) = make_batch(key)
+            t0 = time.perf_counter()
+            with cap.step(i):
+                timers("train-step").start()
+                out = run_step((tokens, labels), metrics, prev_durations)
+                opt_state_box[0], scaler_box[0], loss, metrics = out[:4]
+                tap_state, rank_timings = out[4], out[5]
+                timers("train-step").stop(block=True)
+            prev_durations = (time.perf_counter() - t0, 0.0)
+            rec = logger.log_step(
+                metrics, taps=tap_state,
+                tap_names=step.tap_names() if flight else None)
+            if recorder is not None:
+                recorder.record(i, metrics=rec, taps=tap_state,
+                                timings=rank_timings,
+                                tap_names=step.tap_names())
+            timers.write(["train-step"], logger.writer, i, reset=True)
+            if args.crash_at is not None and i == args.crash_at:
+                raise RuntimeError(
+                    f"injected crash at step {i} (--crash-at)")
     cap.close()
     logger.close()
     print(f"wrote {args.steps} metric records to {args.jsonl} "
           f"({tokens_per_step} tokens/step)")
+    if recorder is not None:
+        recorder.dump(reason="run completed")
+        print(f"flight report at {args.flight_report}")
 
 
 if __name__ == "__main__":

@@ -119,6 +119,64 @@ def test_jsonl_sink_truncates_by_default(tmp_path):
         monitor.JSONLSink(path, mode="x")
 
 
+def test_jsonl_sink_serializes_nonfinite_as_valid_json(tmp_path):
+    """ISSUE 4 satellite regression: json.dumps defaults to
+    allow_nan=True, so a NaN/Inf loss used to emit a bare `NaN` token —
+    invalid JSON that broke every schema-validating reader.  Non-finite
+    floats must land as null + a "<key>_nonfinite" marker."""
+    path = tmp_path / "m.jsonl"
+    sink = monitor.JSONLSink(path)
+    sink.write({"step": 1, "loss": float("nan"),
+                "grad_norm": float("inf"),
+                "update_norm": float("-inf"), "param_norm": 2.0,
+                "nested": {"absmax": float("inf")},
+                "row": [1.0, float("nan")]})
+    sink.close()
+    (line,) = path.read_text().splitlines()
+    assert "NaN" not in line and "Infinity" not in line
+    rec = json.loads(line)  # valid JSON — would raise on bare NaN
+    assert rec["loss"] is None and rec["loss_nonfinite"] == "nan"
+    assert rec["grad_norm"] is None and rec["grad_norm_nonfinite"] == "inf"
+    assert rec["update_norm_nonfinite"] == "-inf"
+    assert rec["param_norm"] == 2.0
+    assert rec["nested"]["absmax_nonfinite"] == "inf"
+    assert rec["row"] == [1.0, "nan"]
+
+
+def test_validate_record_accepts_nonfinite_markers():
+    """A JSONL round-trip of an overflow window (grad_norm null +
+    marker) must validate; a null LOSS must still fail (finiteness is
+    required there)."""
+    logger = monitor.MetricsLogger([])
+    rec = logger.log_step(_fake_metrics())
+    ok = dict(rec, grad_norm=None, grad_norm_nonfinite="inf",
+              overflowed_this_window=True)
+    monitor.validate_record(ok)
+    with pytest.raises(ValueError, match="non-finite"):
+        monitor.validate_record(dict(rec, loss=None,
+                                     loss_nonfinite="nan"))
+
+
+def test_summary_writer_sink_skips_bools_and_autosteps():
+    """ISSUE 4 satellites: bool fields must not land as 0/1 scalar
+    curves (isinstance(True, int) is true), and records without a
+    "step" must fall back to an internal monotonic step, not pile onto
+    tag 0."""
+    calls = []
+
+    class W:
+        def add_scalar(self, tag, value, step):
+            calls.append((tag, value, step))
+
+    sink = monitor.SummaryWriterSink(W())
+    sink.write({"step": 4, "loss": 1.0, "overflowed_this_window": True})
+    assert calls == [("train/loss", 1.0, 4)]
+    calls.clear()
+    sink.write({"loss": 2.0})   # no step: 4 -> 5
+    sink.write({"loss": 3.0})   # -> 6
+    assert calls == [("train/loss", 2.0, 5), ("train/loss", 3.0, 6)]
+
+
 def test_validate_record_rejects_bad_records():
     logger = monitor.MetricsLogger([])
     rec = logger.log_step(_fake_metrics())
@@ -216,6 +274,19 @@ def test_profile_capture_window(tmp_path):
     files = [f for _, _, fs in os.walk(logdir) for f in fs]
     assert files, "profiler trace produced no files"
     cap.close()  # idempotent
+
+
+def test_profile_capture_rejects_gapped_ranges(tmp_path):
+    """ISSUE 4 satellite: {3, 10} used to silently capture its [3, 10]
+    hull; a capture is ONE contiguous trace window, so gaps now raise
+    (two windows = two ProfileCapture objects)."""
+    with pytest.raises(ValueError, match="contiguous"):
+        monitor.profile_capture({3, 10}, logdir=str(tmp_path))
+    with pytest.raises(ValueError, match="contiguous"):
+        monitor.ProfileCapture([0, 2, 3], logdir=str(tmp_path))
+    # contiguous (in any order, duplicates ok) and empty remain fine
+    monitor.ProfileCapture([2, 1, 3, 2], logdir=str(tmp_path))
+    monitor.ProfileCapture((), logdir=str(tmp_path))
 
 
 def test_profile_capture_close_is_safety_net(tmp_path):

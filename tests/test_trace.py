@@ -1,0 +1,439 @@
+"""Numerics flight recorder tests (ISSUE 4): tap op + TapState,
+NaN/overflow provenance, cross-rank straggler timing, crash-dump
+integrity, and — the acceptance criteria — that trace=None rebuilds
+the identical pre-trace step and trace taps change NO training
+numerics (bitwise-equal params)."""
+
+import json
+import os
+import pathlib
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu import amp, monitor
+from apex_tpu.monitor import trace
+from apex_tpu.ops import _common as tapc
+from apex_tpu.optimizers.fused_adam import FusedAdam
+from apex_tpu.parallel import ddp
+from apex_tpu.parallel import mesh as M
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+
+
+# ------------------------------ tap op ------------------------------
+
+def test_tap_is_identity_without_context():
+    """The load-bearing zero-cost contract: no active TapContext means
+    tap() returns ITS INPUT OBJECT — nothing enters the trace."""
+    x = jnp.ones((4, 4))
+    assert tapc.tap(x, "anything") is x
+
+
+def test_tap_stats_values():
+    x = jnp.asarray([[1.0, -3.0], [2.0, 0.0]])
+    s = np.asarray(tapc.tap_stats(x))
+    np.testing.assert_allclose(s[0], 3.0)                    # absmax
+    np.testing.assert_allclose(s[1], 0.0)                    # mean
+    np.testing.assert_allclose(s[2], np.sqrt(14.0 / 4.0), rtol=1e-6)
+    assert s[3] == 0.0
+    bad = jnp.asarray([jnp.nan, jnp.inf, 1.0, -jnp.inf])
+    sb = np.asarray(tapc.tap_stats(bad))
+    assert sb[3] == 3.0          # the count stays finite and exact
+    assert not np.isfinite(sb[0])
+
+
+def test_tap_context_overflow_raises():
+    ctx = tapc.TapContext(probes=trace.make_probes(1))
+    with tapc.tap_context(ctx):
+        tapc.tap(jnp.ones(3), "a")
+        with pytest.raises(ValueError, match="max_taps"):
+            tapc.tap(jnp.ones(3), "b")
+
+
+# --------------------- make_train_step trace plane ---------------------
+
+def _linear_problem():
+    X = jnp.asarray(np.random.default_rng(0).normal(size=(32, 4)),
+                    jnp.float32)
+    Y = X @ jnp.asarray([[1.0], [-2.0], [0.5], [3.0]])
+
+    def loss_fn(params, batch):
+        x, y = batch
+        h = tapc.tap(x @ params["w"], "dense")
+        return jnp.mean((h - y) ** 2)
+
+    return loss_fn, {"w": jnp.zeros((4, 1))}, (X, Y)
+
+
+def _train(mesh, trace_arg, steps=4, amp_on=True):
+    loss_fn, params0, batch = _linear_problem()
+    amp_state = amp.initialize(opt_level="O0", loss_scale="dynamic") \
+        if amp_on else None
+    scaler = amp_state.loss_scalers[0] if amp_on else None
+    opt = FusedAdam(lr=0.05, use_pallas=False)
+    state = opt.init(params0)
+    step = ddp.make_train_step(loss_fn, opt, mesh, amp_state=amp_state,
+                               batch_spec=(P("dp"), P("dp")),
+                               trace=trace_arg)
+    outs = None
+    for _ in range(steps):
+        outs = step(state, scaler, batch)
+        state, scaler = outs[0], outs[1]
+    return state, outs, step
+
+
+def test_trace_off_is_the_pre_trace_step():
+    """Default (trace=None): same output arity and bitwise-identical
+    params as always — the byte-identity acceptance criterion, asserted
+    against the taps-enabled run below."""
+    mesh = M.initialize_model_parallel()
+    state_off, outs_off, _ = _train(mesh, None)
+    assert len(outs_off) == 3  # (opt_state, scaler, loss) — unchanged
+    state_on, outs_on, step = _train(mesh, True)
+    assert len(outs_on) == 4   # + TapState
+    a = np.asarray(jax.device_get(state_off.params))
+    b = np.asarray(jax.device_get(state_on.params))
+    assert a.tobytes() == b.tobytes(), "trace taps changed numerics"
+    assert step.tap_names() == ("dense",)
+    ts = outs_on[-1]
+    assert ts.fwd.shape == (1, 4) and ts.grad.shape == (1, 4)
+    assert int(ts.first_bad_fwd) == -1 and int(ts.first_bad_grad) == -1
+    assert float(ts.fwd[0, 0]) > 0 and float(ts.grad[0, 0]) > 0
+
+
+def test_trace_grad_plane_is_unscaled():
+    """Under dynamic loss scaling the tap's gradient plane reports
+    UNSCALED magnitudes (comparable across scale changes)."""
+    mesh = M.initialize_model_parallel()
+    _, outs_scaled, _ = _train(mesh, True, steps=1, amp_on=True)
+    _, outs_plain, _ = _train(mesh, True, steps=1, amp_on=False)
+    g_scaled = np.asarray(outs_scaled[-1].grad)
+    g_plain = np.asarray(outs_plain[-1].grad)
+    np.testing.assert_allclose(g_scaled[0, 0], g_plain[0, 0], rtol=1e-5)
+
+
+def test_trace_taps_reject_microbatching():
+    mesh = M.initialize_model_parallel()
+    loss_fn, params0, _ = _linear_problem()
+    opt = FusedAdam(lr=0.05, use_pallas=False)
+    with pytest.raises(ValueError, match="num_microbatches"):
+        ddp.make_train_step(loss_fn, opt, mesh, num_microbatches=2,
+                            trace=True)
+    # the timing-only config composes with microbatching
+    ddp.make_train_step(loss_fn, opt, mesh, num_microbatches=2,
+                        trace=trace.TraceConfig(taps=False,
+                                                rank_timing=True))
+
+
+def test_bert_tap_points_discoverable():
+    """BERT threads the same tap points; discovery mode (names only,
+    no probes) enumerates them without running the model."""
+    from apex_tpu.models.bert import Bert, BertConfig
+
+    cfg = BertConfig(vocab_size=64, seq_len=16, hidden=32, num_layers=2,
+                     num_heads=4)
+    from jax import shard_map
+
+    mesh = M.initialize_model_parallel()
+    model = Bert(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+    tokens = jnp.zeros((2, 16), jnp.int32)
+    fn = shard_map(model.encode, mesh=mesh, in_specs=(P(), P()),
+                   out_specs=P(), check_vma=False)
+    ctx = tapc.TapContext(discover=True)
+    with tapc.tap_context(ctx):
+        jax.eval_shape(fn, params, tokens)
+    assert ctx.names == [f"block{i}/{p}" for i in range(2)
+                         for p in ("ln1", "attn", "ln2", "mlp")]
+
+
+# ----------------------- NaN injection provenance -----------------------
+
+def _tiny_gpt_step(params, trace_arg=True):
+    from apex_tpu.models.gpt import GPT, GPTConfig
+
+    mesh = M.initialize_model_parallel()
+    cfg = GPTConfig(vocab_size=64, seq_len=16, hidden=32, num_layers=3,
+                    num_heads=4)
+    model = GPT(cfg)
+    if params is None:
+        params = model.init(jax.random.PRNGKey(0))
+    opt = FusedAdam(lr=1e-3, use_pallas=False)
+
+    def loss_fn(p, batch):
+        tokens, labels = batch
+        return model.loss(p, tokens, labels)
+
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (8, 16), 0, 64)
+    batch = (tokens, jnp.roll(tokens, -1, 1))
+    step = ddp.make_train_step(loss_fn, opt, mesh,
+                               batch_spec=(P("dp"), P("dp")),
+                               trace=trace_arg)
+    out = step(opt.init(params), None, batch)
+    return params, out, step
+
+
+def test_gpt_taps_bitwise_and_names():
+    params, out_on, step = _tiny_gpt_step(None, trace_arg=True)
+    _, out_off, _ = _tiny_gpt_step(params, trace_arg=None)
+    a = np.asarray(jax.device_get(out_off[0].params))
+    b = np.asarray(jax.device_get(out_on[0].params))
+    assert a.tobytes() == b.tobytes()
+    names = step.tap_names()
+    assert len(names) == 3 * 4  # ln1/attn/ln2/mlp per block
+    assert names[0] == "block0/ln1" and names[5] == "block1/attn"
+
+
+def test_gpt_nan_injection_attributed_in_report(tmp_path):
+    """ISSUE 4 acceptance: a seeded NaN at a known layer is attributed
+    to that layer's tap in the DUMPED report."""
+    from apex_tpu.models.gpt import GPT, GPTConfig
+
+    cfg = GPTConfig(vocab_size=64, seq_len=16, hidden=32, num_layers=3,
+                    num_heads=4)
+    params = GPT(cfg).init(jax.random.PRNGKey(0))
+    # poison block1's attention projection: the first tap downstream of
+    # it in forward order is block1/attn
+    w = np.asarray(params["block1"]["proj"]["weight"]).copy()
+    w[0, 0] = np.nan
+    params["block1"]["proj"]["weight"] = jnp.asarray(w)
+
+    _, out, step = _tiny_gpt_step(params, trace_arg=True)
+    tap_state = out[-1]
+    names = step.tap_names()
+    prov = trace.provenance(tap_state, names)
+    assert prov is not None
+    assert prov["plane"] == "fwd" and prov["tap"] == "block1/attn"
+    assert prov["stats"]["nonfinite"] > 0
+
+    path = tmp_path / "flight.json"
+    rec = trace.FlightRecorder(path, capacity=4, tap_names=names)
+    rec.record(7, taps=tap_state)
+    rep = rec.dump(reason="test")
+    on_disk = json.loads(path.read_text())  # parseable despite NaNs
+    trace.validate_report(on_disk)
+    assert on_disk["records"][0]["taps"]["first_bad_fwd"] == "block1/attn"
+    text = trace.render_report(on_disk)
+    assert "block1/attn" in text and "first bad step: 7" in text
+
+
+def test_amp_overflow_grad_plane_provenance():
+    """A loss-scaling overflow (clean forward, non-finite scaled grads)
+    attributes on the GRADIENT plane, and FP16_Optimizer surfaces it
+    via overflow_provenance()."""
+    big = 3e38  # finite in f32; the 2^16-scaled cotangent overflows
+
+    def loss_fn(p, x):
+        h = tapc.tap(x @ p["w"], "dense")
+        return jnp.sum(h) * big
+
+    from apex_tpu.amp.fp16_optimizer import FP16_Optimizer
+
+    x = jnp.ones((4, 4))
+    p = {"w": jnp.full((4, 1), 1e-3)}
+    probes = trace.make_probes(4)
+    fp16 = FP16_Optimizer(FusedAdam(lr=0.1, use_pallas=False),
+                          dynamic_loss_scale=True)
+    state = fp16.init(p)
+
+    def scaled(p_probes, x):
+        pp, pr = p_probes
+        ctx = tapc.TapContext(probes=pr)
+        with tapc.tap_context(ctx):
+            loss = loss_fn(pp, x)
+        return fp16.scale_loss(loss), tuple(ctx.names)
+
+    (grads, probe_g), names = jax.grad(scaled, has_aux=True)((p, probes), x)
+    tap_state = trace.finalize(probe_g, len(names))
+    assert int(tap_state.first_bad_fwd) == -1   # forward was clean
+    assert int(tap_state.first_bad_grad) == 0   # scaled cotangent: inf
+
+    _, state, = fp16.step(state, grads, tap_state=tap_state,
+                          tap_names=names)
+    assert bool(fp16.scaler_state.found_inf)    # the skip happened
+    prov = fp16.overflow_provenance()
+    assert prov == {"plane": "grad", "tap": "dense", "index": 0,
+                    "stats": prov["stats"]}
+    assert prov["stats"]["nonfinite"] > 0
+
+
+# -------------------------- cross-rank timing --------------------------
+
+def test_straggler_detector_unit():
+    det = trace.StragglerDetector(threshold=1.5, patience=2)
+    even = np.full((4, 2), 0.1)
+    s = det.update(even)
+    assert s["skew"] == pytest.approx(1.0) and not s["flagged"]
+    slow = even.copy()
+    slow[2, 0] = 0.3
+    det.update(slow)
+    assert det.flagged_ranks == ()          # 1 outlier step < patience
+    s = det.update(slow)
+    assert det.flagged_ranks == (2,)
+    assert s["flagged"][0]["skew"] == pytest.approx(3.0)
+    assert s["max_rank"] == 2
+    det.update(even)                        # recovery resets the count
+    assert det.flagged_ranks == ()
+    with pytest.raises(ValueError, match="threshold"):
+        trace.StragglerDetector(threshold=1.0)
+
+
+def test_train_step_rank_timing_flags_delayed_rank():
+    """ISSUE 4 acceptance: >= 2 simulated dp shards, an artificially
+    delayed rank is flagged with the correct rank id and skew, via ONE
+    small all_gather per step."""
+    mesh = M.initialize_model_parallel()
+    dp = mesh.shape[M.DP_AXIS]
+    assert dp >= 2
+    loss_fn, params0, batch = _linear_problem()
+    opt = FusedAdam(lr=0.05, use_pallas=False)
+    state = opt.init(params0)
+    cfg = trace.TraceConfig(taps=False, rank_timing=True)
+    step = ddp.make_train_step(loss_fn, opt, mesh,
+                               batch_spec=(P("dp"), P("dp")), trace=cfg)
+    det = trace.StragglerDetector(threshold=1.5, patience=3)
+    delayed = 3
+    for _ in range(3):
+        local = np.full((dp, 2), 0.1, np.float32)
+        local[delayed, 0] = 0.35  # the artificial delay
+        out = step(state, None, batch, jnp.asarray(local))
+        state, gathered = out[0], out[-1]
+        # the all_gather must replicate every rank's vector verbatim
+        np.testing.assert_allclose(np.asarray(gathered), local)
+        det.update(gathered)
+    assert det.flagged_ranks == (delayed,)
+    assert det.last["flagged"][0]["skew"] == pytest.approx(3.5)
+    assert det.last["max_rank"] == delayed
+
+
+def test_fbnp_rank_timing_gather():
+    from jax import shard_map
+    from apex_tpu.transformer.pipeline_parallel.schedules import (
+        forward_backward_no_pipelining)
+
+    mesh = M.initialize_model_parallel()
+    dp = mesh.shape[M.DP_AXIS]
+    w = {"w": jnp.asarray([[2.0], [1.0]])}
+    batch = jnp.asarray(np.random.default_rng(1).normal(size=(4, 8, 2)),
+                        jnp.float32)
+
+    def fwd(p, mb):
+        return jnp.mean((mb @ p["w"]) ** 2)
+
+    # legacy return shape untouched
+    out = forward_backward_no_pipelining(fwd, batch, w, num_microbatches=4)
+    assert len(out) == 2
+
+    def local(params, b, timing):
+        loss, grads, gathered = forward_backward_no_pipelining(
+            fwd, b, params, num_microbatches=4,
+            rank_timing=timing.reshape(-1))
+        return loss, gathered
+
+    timing = np.tile(np.asarray([[0.1, 0.02]], np.float32), (dp, 1))
+    timing[1, 0] = 0.5
+    fn = jax.jit(shard_map(
+        local, mesh=mesh, in_specs=(P(), P(), P("dp")),
+        out_specs=(P(), P()), check_vma=False))
+    _, gathered = fn(w, batch, jnp.asarray(timing))
+    np.testing.assert_allclose(np.asarray(gathered), timing)
+
+
+# ------------------------- recorder + report -------------------------
+
+def test_flight_recorder_ring_and_guard(tmp_path):
+    path = tmp_path / "r.json"
+    rec = trace.FlightRecorder(path, capacity=3, tap_names=["a"])
+    with pytest.raises(RuntimeError, match="boom"):
+        with rec.guard():
+            for i in range(5):
+                rec.record(i, metrics={"step": i, "loss": float(i)})
+            raise RuntimeError("boom")
+    data = json.loads(path.read_text())
+    trace.validate_report(data)
+    assert data["reason"].startswith("exception: RuntimeError")
+    assert [r["step"] for r in data["records"]] == [2, 3, 4]  # ring of 3
+    assert len(rec) == 3
+    with pytest.raises(ValueError, match="capacity"):
+        trace.FlightRecorder(path, capacity=0)
+
+
+def test_validate_report_rejects_drift(tmp_path):
+    rec = trace.FlightRecorder(tmp_path / "r.json", capacity=2)
+    rep = rec.report()
+    trace.validate_report(rep)
+    with pytest.raises(ValueError, match="flight_recorder_version"):
+        trace.validate_report(dict(rep, flight_recorder_version=99))
+    with pytest.raises(ValueError, match="missing report field"):
+        trace.validate_report({k: v for k, v in rep.items()
+                               if k != "tap_names"})
+
+
+def test_logger_tap_summary_fields(tmp_path):
+    ts = trace.TapState(
+        fwd=jnp.asarray([[2.0, 0.0, 1.0, 0.0], [3.0, 0.0, 1.0, 0.0]]),
+        grad=jnp.asarray([[0.5, 0.0, 0.1, 0.0],
+                          [jnp.inf, jnp.nan, jnp.inf, 7.0]]),
+        first_bad_fwd=jnp.asarray(-1, jnp.int32),
+        first_bad_grad=jnp.asarray(1, jnp.int32))
+    path = tmp_path / "m.jsonl"
+    logger = monitor.MetricsLogger([monitor.JSONLSink(path)], taps=True)
+    m = monitor.init_metrics()._replace(step=jnp.asarray(1, jnp.int32))
+    rec = logger.log_step(m, taps=ts, tap_names=["l0", "l1"])
+    assert rec["tap_fwd_absmax"] == 3.0
+    assert rec["tap_nonfinite"] == 7.0
+    assert rec["tap_first_bad"] == "l1"
+    logger.close()
+    (line,) = path.read_text().splitlines()
+    disk = json.loads(line)  # inf serialized as null + marker
+    assert disk["tap_grad_absmax"] is None
+    assert disk["tap_grad_absmax_nonfinite"] == "inf"
+
+
+# ----------------------- CLI + crash-dump gates -----------------------
+
+def _run_script(path, *args, timeout=600):
+    return subprocess.run(
+        [sys.executable, str(path), *args], capture_output=True,
+        text=True, timeout=timeout,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+
+
+def test_flight_report_selftest():
+    """Tier-1 CI gate (mirrors `gpt_anatomy.py tune --check`): the
+    committed fixture renders under the CURRENT schema."""
+    r = _run_script(ROOT / "scripts" / "flight_report.py", "--selftest")
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "flight_report --selftest: OK" in r.stdout
+
+
+def test_crash_dump_integrity(tmp_path):
+    """ISSUE 4 satellite: the demo path raises mid-loop; the dumped
+    report must be complete, parseable JSON at the expected ring
+    depth — and renderable."""
+    report = tmp_path / "flight.json"
+    r = _run_script(ROOT / "examples" / "train_with_monitor.py",
+                    "--steps", "6", "--jsonl", str(tmp_path / "m.jsonl"),
+                    "--flight-report", str(report),
+                    "--flight-capacity", "4", "--crash-at", "3",
+                    "--force-cpu-devices", "1")
+    assert r.returncode != 0, "injected crash must propagate"
+    assert "injected crash at step 3" in r.stderr
+    data = json.loads(report.read_text())
+    trace.validate_report(data)
+    assert data["reason"].startswith("exception: RuntimeError")
+    # steps 0..3 recorded, ring keeps the last 4
+    assert [rec["step"] for rec in data["records"]] == [0, 1, 2, 3]
+    for rec in data["records"]:
+        assert rec["taps"] is not None and rec["timings"] is not None
+    assert data["straggler"]["steps_seen"] == 4
+    # the renderer consumes what the recorder wrote
+    r2 = _run_script(ROOT / "scripts" / "flight_report.py", str(report))
+    assert r2.returncode == 0, r2.stderr
+    assert "no non-finite step in the recorded window" in r2.stdout
