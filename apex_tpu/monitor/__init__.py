@@ -17,6 +17,11 @@ Three layers:
                taps with NaN/overflow provenance, cross-rank timing +
                straggler detection, and the crash-dump ring buffer
                (`monitor.trace` subpackage)
+  * compile  — the compile & HBM observatory (ISSUE 5): AOT memory/
+               cost audit (`analyze_step` -> `CompileReport`, HBM
+               budget table, donation + flops cross-checks), the
+               `RecompileSentry`, and device-memory watermarks + OOM
+               forensics (`monitor.compile` subpackage)
 
 See docs/observability.md for the JSONL schema and recipes, and
 examples/train_with_monitor.py for the end-to-end loop.
@@ -24,11 +29,21 @@ examples/train_with_monitor.py for the end-to-end loop.
 
 from apex_tpu.monitor import flops  # noqa: F401
 from apex_tpu.monitor.flops import (  # noqa: F401
+    DEVICE_BF16_PEAKS,
     V5E_BF16_PEAK,
     bert_step_flops,
+    device_peak_flops,
     gpt_step_flops,
     mfu,
     transformer_step_flops,
+)
+from apex_tpu.monitor import compile  # noqa: F401,A004 — subpackage
+from apex_tpu.monitor.compile import (  # noqa: F401
+    CompileReport,
+    RecompileSentry,
+    analyze_step,
+    device_memory_stats,
+    render_budget_table,
 )
 from apex_tpu.monitor.logger import (  # noqa: F401
     SCHEMA,

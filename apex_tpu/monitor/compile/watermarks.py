@@ -1,0 +1,84 @@
+"""Live device-memory watermarks + OOM classification.
+
+TPU runtimes expose an allocator snapshot via
+`device.memory_stats()` — `bytes_in_use`, `peak_bytes_in_use`,
+`bytes_limit`, ...  Sampling it is a host-side dict read (no device
+sync, no effect on the compiled program), so the logger can stamp the
+two watermark fields into every record at log interval.  CPU backends
+return None; every helper here degrades to None fields rather than
+raising — the JSONL schema treats `hbm_*` as optional-null.
+
+`is_oom(exc)` classifies the exception the flight-recorder guard just
+caught: a RESOURCE_EXHAUSTED (or allocator "out of memory") death gets
+the full forensics treatment — the dump attaches the last
+`CompileReport` and a fresh memory snapshot, so the run dies with a
+budget table instead of a bare stack trace.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Optional
+
+import jax
+
+# the fields worth carrying; anything else the runtime reports rides
+# along untouched in device_memory_stats()' full dict
+WATERMARK_FIELDS = ("bytes_in_use", "peak_bytes_in_use", "bytes_limit")
+
+_OOM_MARKERS = ("RESOURCE_EXHAUSTED", "RESOURCE EXHAUSTED",
+                "Out of memory", "out of memory")
+# bare "OOM" must match as a word — "BLOOM"/"ZOOM" in an error message
+# is not an allocator death, and a wrongly-classified crash dump
+# renders actively misleading forensics
+_OOM_WORD = re.compile(r"\bOOM\b")
+
+
+def device_memory_stats(device=None) -> Optional[dict]:
+    """One device's allocator snapshot, or None when the backend does
+    not report (CPU, older runtimes).  device defaults to
+    `jax.devices()[0]` — the addressable chip this process feeds."""
+    try:
+        if device is None:
+            device = jax.devices()[0]
+        stats = device.memory_stats()
+    except Exception:
+        return None
+    if not isinstance(stats, dict):
+        return None
+    return stats
+
+
+def hbm_watermarks(device=None) -> dict:
+    """The per-record watermark fields, always present, None when the
+    backend withholds them: {"hbm_bytes_in_use": int|None,
+    "hbm_peak_bytes_in_use": int|None, "hbm_bytes_limit": int|None}."""
+    stats = device_memory_stats(device) or {}
+    return {f"hbm_{k}": (int(stats[k]) if k in stats else None)
+            for k in WATERMARK_FIELDS}
+
+
+def all_device_memory_stats() -> Optional[dict]:
+    """{device_id: memory_stats dict} over local devices, or None when
+    no device reports — the crash-dump form (an OOM on chip 3 of 4
+    should name chip 3)."""
+    out = {}
+    try:
+        devices = jax.local_devices()
+    except Exception:
+        return None
+    for d in devices:
+        s = device_memory_stats(d)
+        if s is not None:
+            out[str(getattr(d, "id", len(out)))] = s
+    return out or None
+
+
+def is_oom(exc: BaseException) -> bool:
+    """True when the exception is an allocator death worth full
+    forensics (RESOURCE_EXHAUSTED / out-of-memory / the word "OOM"),
+    matched on the repr so it works across jaxlib's exception-type
+    renames."""
+    msg = repr(exc)
+    return (any(m in msg for m in _OOM_MARKERS)
+            or _OOM_WORD.search(msg) is not None)

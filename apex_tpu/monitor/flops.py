@@ -17,8 +17,68 @@ the committed roofline tables in docs/PERF.md:
 
 from __future__ import annotations
 
-# v5e bf16 matmul peak — the PEAK constant of scripts/gpt_anatomy.py.
+from typing import Optional
+
+# v5e bf16 matmul peak — the PEAK constant of scripts/gpt_anatomy.py,
+# and the documented fallback when the device kind is unknown (CPU
+# test runs, exotic kinds): existing published numbers don't move.
 V5E_BF16_PEAK = 197e12
+
+# normalized device generation -> per-chip bf16 dense matmul peak
+# (FLOP/s).  Sources: the public TPU spec sheets; v5e matches the
+# PEAK every roofline table in docs/PERF.md scores against.
+DEVICE_BF16_PEAKS = {
+    "v2": 45e12,
+    "v3": 123e12,
+    "v4": 275e12,
+    "v5e": 197e12,
+    "v5p": 459e12,
+    "v6e": 918e12,
+}
+
+
+def _normalize_device_kind(kind: str) -> Optional[str]:
+    """Map a raw `device.device_kind` string ("TPU v4", "TPU v5 lite",
+    "TPU v5e", "TPU v5p", "TPU v6 lite"...) onto a DEVICE_BF16_PEAKS
+    key.  Order matters: "v5 lite"/"v5e" must win before the bare
+    "v5" of v5p-style strings."""
+    k = kind.lower()
+    if "v6" in k or "trillium" in k:
+        return "v6e"
+    if "v5e" in k or "v5 lite" in k or "v5lite" in k:
+        return "v5e"
+    if "v5p" in k or "v5" in k:
+        return "v5p"
+    if "v4" in k:
+        return "v4"
+    if "v3" in k:
+        return "v3"
+    if "v2" in k:
+        return "v2"
+    return None
+
+
+def device_peak_flops(device_kind: Optional[str] = None, *,
+                      override: Optional[float] = None,
+                      default: float = V5E_BF16_PEAK) -> float:
+    """Per-chip bf16 peak for MFU, resolved from the device kind.
+
+    override wins outright (the explicit knob — a sliced-clock pod, a
+    peak measured rather than quoted).  device_kind=None reads
+    `jax.devices()[0].device_kind`; an unrecognized kind (including
+    "cpu") falls back to `default` = V5E_BF16_PEAK, so every number
+    published before this table existed is unchanged.
+    """
+    if override is not None:
+        return float(override)
+    if device_kind is None:
+        try:
+            import jax
+            device_kind = jax.devices()[0].device_kind
+        except Exception:
+            return default
+    norm = _normalize_device_kind(str(device_kind))
+    return DEVICE_BF16_PEAKS.get(norm, default)
 
 
 def transformer_step_flops(*, hidden: int, num_layers: int,
@@ -61,10 +121,18 @@ def bert_step_flops(config, batch: int, seq=None) -> int:
 
 
 def mfu(flops_per_step: float, step_time_s: float,
-        peak_flops: float = V5E_BF16_PEAK) -> float:
+        peak_flops: Optional[float] = None) -> float:
     """Model FLOP utilization in [0, inf): achieved model FLOP/s over
     the hardware peak.  >1 means the accounting under-counts (or the
-    peak is wrong for the backend)."""
+    peak is wrong for the backend).
+
+    peak_flops=None resolves the per-chip peak from the device kind
+    (`device_peak_flops`); unknown kinds — CPU test runs included —
+    fall back to V5E_BF16_PEAK, so pre-table numbers don't move.
+    Multi-chip MFU wants the AGGREGATE peak: pass
+    `device_peak_flops() * n_chips` explicitly."""
+    if peak_flops is None:
+        peak_flops = device_peak_flops()
     if step_time_s <= 0 or peak_flops <= 0:
         return 0.0
     return flops_per_step / step_time_s / peak_flops

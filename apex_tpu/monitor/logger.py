@@ -29,7 +29,12 @@ from apex_tpu.monitor.sinks import MetricSink, ScalarWriter
 # and tap-enabled loggers stamp the tap_* summary fields — same
 # required fields as v1, but v1 readers would mis-parse an overflow
 # record, so the version moves.
-SCHEMA_VERSION = 2
+# v3 (ISSUE 5): the compile & HBM observatory fields — `n_compiles`
+# (RecompileSentry), `hbm_bytes_in_use` / `hbm_peak_bytes_in_use` /
+# `hbm_bytes_limit` (device watermarks; null on backends that don't
+# report) — all OPTIONAL, type-checked by validate_record only when
+# present (OPTIONAL_SCHEMA).
+SCHEMA_VERSION = 3
 
 # field -> (python type, finite_required).  loss_scale may legitimately
 # be large but is finite; grad/update norms are inf/nan ON overflow
@@ -50,6 +55,20 @@ SCHEMA = {
     "tokens_per_sec": (float, True),
     "mfu": (float, True),
 }
+
+# optional v3 fields (ISSUE 5) — validated only when present.  The
+# bool flag is none_ok: watermark fields are null on backends whose
+# allocator doesn't report (CPU), while a present n_compiles must be a
+# real count.  Any other `compile_*`/`hbm_*` key must be a JSON scalar
+# or null (the prefix is reserved for the observatory).
+OPTIONAL_SCHEMA = {
+    "n_compiles": (int, False),
+    "steady_recompiles": (int, False),
+    "hbm_bytes_in_use": (int, True),
+    "hbm_peak_bytes_in_use": (int, True),
+    "hbm_bytes_limit": (int, True),
+}
+_OPTIONAL_PREFIXES = ("compile_", "hbm_")
 
 
 def validate_record(record: dict, prev_step: Optional[int] = None) -> None:
@@ -83,6 +102,25 @@ def validate_record(record: dict, prev_step: Optional[int] = None) -> None:
         if name == "grad_norm" and not overflowed and not math.isfinite(v):
             raise ValueError(f"grad_norm non-finite ({v}) on a step that "
                              "did not overflow")
+    for name, (typ, none_ok) in OPTIONAL_SCHEMA.items():
+        if name not in record:
+            continue
+        v = record[name]
+        if v is None:
+            if not none_ok:
+                raise ValueError(f"optional field {name!r} is null but "
+                                 "must carry a value when present")
+            continue
+        if not isinstance(v, typ) or isinstance(v, bool):
+            raise ValueError(f"optional field {name!r} is "
+                             f"{type(v).__name__}, want {typ.__name__}")
+    for k, v in record.items():
+        if (k.startswith(_OPTIONAL_PREFIXES) and k not in OPTIONAL_SCHEMA
+                and not k.endswith("_nonfinite")
+                and not isinstance(v, (int, float, str, type(None)))):
+            raise ValueError(
+                f"observatory field {k!r} must be a JSON scalar or "
+                f"null, got {type(v).__name__}")
     if record["step"] < 0:
         raise ValueError(f"negative step {record['step']}")
     if prev_step is not None and record["step"] <= prev_step:
@@ -102,8 +140,9 @@ class MetricsLogger:
     """Derive rates + write records.
 
     flops_per_step enables MFU (use `monitor.flops.gpt_step_flops` et
-    al.); peak_flops defaults to the v5e bf16 peak that
-    scripts/gpt_anatomy.py scores against.  `.writer` is a
+    al.); peak_flops=None resolves the per-chip peak from the device
+    kind (`flops.device_peak_flops`), falling back to the v5e bf16
+    peak that scripts/gpt_anatomy.py scores against.  `.writer` is a
     SummaryWriter-compatible `ScalarWriter` over the SAME sinks, so
     `Timers.write(names, logger.writer, iteration)` interleaves timer
     scalars into the same stream.
@@ -111,12 +150,31 @@ class MetricsLogger:
 
     def __init__(self, sinks: Sequence[MetricSink], *,
                  flops_per_step: Optional[float] = None,
-                 peak_flops: float = flops_lib.V5E_BF16_PEAK,
+                 peak_flops: Optional[float] = None,
                  log_tuner: bool = True,
-                 taps: bool = False):
+                 taps: bool = False,
+                 sentry=None,
+                 memory: bool = False,
+                 memory_device=None):
         self.sinks = list(sinks)
         self.flops_per_step = flops_per_step
-        self.peak_flops = peak_flops
+        # None resolves the per-chip peak from the device kind (ISSUE 5
+        # satellite) LAZILY — device_peak_flops() touches jax.devices()
+        # and would force backend init as a constructor side effect;
+        # the resolution happens on the first log_step that actually
+        # computes MFU.  Unknown kinds fall back to V5E_BF16_PEAK so
+        # pre-table numbers don't move; multi-chip runs still pass the
+        # aggregate peak explicitly.
+        self._peak_flops = peak_flops
+        # sentry: a compile.RecompileSentry — every record gains
+        # `n_compiles` (+ `steady_recompiles` once any happened), so a
+        # silent retrace is visible in the same JSONL stream as the
+        # step-time it inflated.  memory: stamp the hbm_* device
+        # watermarks per record (None on backends that don't report —
+        # the fields stay, null; schema-legal by OPTIONAL_SCHEMA).
+        self.sentry = sentry
+        self.memory = memory
+        self.memory_device = memory_device
         # taps=True: log_step(…, taps=tap_state) folds the flight
         # recorder's per-layer stat planes into each record as compact
         # summary fields (tap_fwd_absmax / tap_grad_absmax /
@@ -134,6 +192,16 @@ class MetricsLogger:
         self._last_step = 0
         self._last_tokens = 0.0
         self._last_overflows = 0
+
+    @property
+    def peak_flops(self) -> float:
+        if self._peak_flops is None:
+            self._peak_flops = flops_lib.device_peak_flops()
+        return self._peak_flops
+
+    @peak_flops.setter
+    def peak_flops(self, value) -> None:
+        self._peak_flops = value
 
     def reset_timer(self, metrics: Optional[MetricsState] = None) -> None:
         """Restart the rate window (call after warmup/compile so the
@@ -196,6 +264,14 @@ class MetricsLogger:
                 pass
         if self.taps and taps is not None:
             record.update(self._tap_summary(taps, tap_names))
+        if self.sentry is not None:
+            record["n_compiles"] = int(self.sentry.n_compiles)
+            if self.sentry.steady_recompiles:
+                record["steady_recompiles"] = int(
+                    self.sentry.steady_recompiles)
+        if self.memory:
+            import apex_tpu.monitor.compile.watermarks as _wm
+            record.update(_wm.hbm_watermarks(self.memory_device))
         if extra:
             record.update(extra)
         for s in self.sinks:

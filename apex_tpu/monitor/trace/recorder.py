@@ -14,15 +14,27 @@ the last-good → first-bad timeline.
 Report schema (validated by `report.validate_report`; bump
 FLIGHT_RECORDER_VERSION on any field add/rename/re-semantics):
 
-    {"flight_recorder_version": 1,
+    {"flight_recorder_version": 2,
      "monitor_schema_version":  <logger.SCHEMA_VERSION>,
      "reason": "exception: ..." | "explicit" | ...,
+     "oom": bool,                        # RESOURCE_EXHAUSTED death?
      "capacity": N, "tap_names": [...], "timing_fields": [...],
      "straggler": {...} | null,          # StragglerDetector.summary()
+     "compile_report": {...} | null,     # last attached CompileReport
+     "compile_events": [{...}],          # RecompileSentry events
+     "memory": {device_id: stats} | null,  # memory_stats at dump time
      "records": [{"step": int,
                   "metrics": {...} | null,   # flat MetricsLogger record
                   "taps": {...} | null,      # taps.taps_to_dict shape
                   "timings": {"per_rank": [[...], ...]} | null}]}
+
+v2 (ISSUE 5) added the compile & HBM observatory plane: the last
+`CompileReport` (attach via `attach_compile_report`, or let
+`compile.RecompileSentry(step, recorder=...)` push its events), and —
+the OOM-forensics contract — `guard()` classifies a
+RESOURCE_EXHAUSTED death (`compile.is_oom`) and dumps with `oom:
+true` plus a fresh per-device memory snapshot, so an OOM dies with a
+budget table instead of a bare stack trace.
 
 Non-finite floats (an overflow step's absmax is ±inf by construction)
 are serialized through `sinks.sanitize_json_floats` — the report is
@@ -43,7 +55,12 @@ from apex_tpu.monitor.sinks import sanitize_json_floats
 from apex_tpu.monitor.trace import taps as taps_lib
 from apex_tpu.monitor.trace.straggler import StragglerDetector
 
-FLIGHT_RECORDER_VERSION = 1
+FLIGHT_RECORDER_VERSION = 2
+
+# compile events are rare (a healthy run has a handful at warmup);
+# bound the list anyway — a pathological retrace-every-step run must
+# not grow the crash artifact without bound
+_MAX_COMPILE_EVENTS = 64
 
 
 class FlightRecorder:
@@ -72,9 +89,27 @@ class FlightRecorder:
         # see record(): the newest step's output may still be in
         # flight, so its device_get is deferred one call)
         self._pending_timings = collections.deque()
+        # the compile & HBM observatory plane (ISSUE 5)
+        self._compile_report = None
+        self._compile_events = collections.deque(
+            maxlen=_MAX_COMPILE_EVENTS)
 
     def __len__(self) -> int:
         return len(self._ring)
+
+    def attach_compile_report(self, report) -> None:
+        """Keep the latest AOT audit (`compile.CompileReport` or its
+        to_dict form) so a crash — an OOM especially — dumps WITH the
+        HBM budget that explains it."""
+        if hasattr(report, "to_dict"):
+            report = report.to_dict()
+        self._compile_report = report
+
+    def note_compile_event(self, event: dict) -> None:
+        """Record one sentry compile event (bounded list; the
+        `compile.RecompileSentry(step, recorder=...)` hookup calls
+        this so retraces land in the crash artifact)."""
+        self._compile_events.append(dict(event))
 
     def record(self, step: int, *, metrics: Optional[dict] = None,
                taps=None, timings=None,
@@ -103,7 +138,7 @@ class FlightRecorder:
             {"step": int(step), "metrics": metrics, "taps": taps,
              "timings": timings})
 
-    def report(self, reason: str = "explicit") -> dict:
+    def report(self, reason: str = "explicit", oom: bool = False) -> dict:
         """Materialize the report dict (device_gets the ring)."""
         while self._pending_timings:  # the deferred straggler fold
             try:
@@ -128,23 +163,34 @@ class FlightRecorder:
                 rec["fetch_error"] = repr(e)  # cost us the whole report
             records.append(rec)
         from apex_tpu.monitor import logger as logger_lib
+        import apex_tpu.monitor.compile.watermarks as wm
+        try:
+            # a fresh allocator snapshot at dump time (None on CPU);
+            # on an OOM this is the "how full was the chip" answer
+            memory = wm.all_device_memory_stats()
+        except Exception:  # pragma: no cover — never cost the report
+            memory = None
         return {
             "flight_recorder_version": FLIGHT_RECORDER_VERSION,
             "monitor_schema_version": logger_lib.SCHEMA_VERSION,
             "reason": reason,
+            "oom": bool(oom),
             "capacity": self.capacity,
             "tap_names": list(self.tap_names or []),
             "timing_fields": list(self.timing_fields),
             "straggler": (self.straggler.summary()
                           if self.straggler is not None else None),
+            "compile_report": self._compile_report,
+            "compile_events": list(self._compile_events),
+            "memory": memory,
             "records": records,
         }
 
-    def dump(self, reason: str = "explicit") -> dict:
+    def dump(self, reason: str = "explicit", oom: bool = False) -> dict:
         """Write the report to `self.path` (atomic: tmp + rename — a
         crash artifact that is itself truncated is worse than none) and
         return it."""
-        rep = sanitize_json_floats(self.report(reason))
+        rep = sanitize_json_floats(self.report(reason, oom=oom))
         d = os.path.dirname(self.path)
         if d:
             os.makedirs(d, exist_ok=True)
@@ -158,9 +204,15 @@ class FlightRecorder:
     @contextlib.contextmanager
     def guard(self):
         """Wrap the training loop: any exception dumps the report
-        (reason = the exception repr) and re-raises."""
+        (reason = the exception repr) and re-raises.  A
+        RESOURCE_EXHAUSTED / out-of-memory death (`compile.is_oom`)
+        dumps with `oom: true` — together with the attached
+        CompileReport and the per-device memory snapshot the report
+        already carries, the run dies with an HBM budget table
+        instead of a bare stack trace."""
+        import apex_tpu.monitor.compile.watermarks as wm
         try:
             yield self
         except BaseException as e:
-            self.dump(reason=f"exception: {e!r}")
+            self.dump(reason=f"exception: {e!r}", oom=wm.is_oom(e))
             raise

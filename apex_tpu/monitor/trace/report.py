@@ -17,8 +17,9 @@ from typing import List, Optional
 from apex_tpu.monitor.trace.recorder import FLIGHT_RECORDER_VERSION
 
 _REQUIRED_TOP = ("flight_recorder_version", "monitor_schema_version",
-                 "reason", "capacity", "tap_names", "timing_fields",
-                 "straggler", "records")
+                 "reason", "oom", "capacity", "tap_names",
+                 "timing_fields", "straggler", "compile_report",
+                 "compile_events", "memory", "records")
 _REQUIRED_REC = ("step", "metrics", "taps", "timings")
 
 
@@ -102,6 +103,10 @@ def render_report(report: dict, last: Optional[int] = None) -> str:
     else:
         lines.append("ring: empty")
 
+    if report.get("oom"):
+        lines.append("!! OOM: the run died RESOURCE_EXHAUSTED — HBM "
+                     "budget below")
+
     strag = report.get("straggler")
     if strag and strag.get("last"):
         s = strag["last"]
@@ -114,6 +119,49 @@ def render_report(report: dict, last: Optional[int] = None) -> str:
             lines.append(
                 f"  ** STRAGGLER rank {f['rank']}: {f['skew']:.2f}x "
                 f"median for {f['consecutive']} consecutive steps")
+
+    events = report.get("compile_events") or []
+    if events:
+        steady = [e for e in events if e.get("steady_state")]
+        lines.append(f"compile: {len(events)} compile event(s), "
+                     f"{len(steady)} steady-state")
+        for e in events[-4:]:  # the tail tells the story
+            sig = str(e.get("signature", ""))[:100]
+            tag = ("** RECOMPILE" if e.get("steady_state")
+                   else "   compile")
+            lines.append(f"{tag} at call {e.get('call')} "
+                         f"[{e.get('kind')}]: {sig}")
+
+    mem = report.get("memory") or {}
+    # device ids are stringified ints: numeric order, not lexicographic
+    # (a 16-chip host must not render 0, 1, 10, 11, ..., 2, ...)
+    def _dev_key(kv):
+        return (0, int(kv[0])) if kv[0].isdigit() else (1, kv[0])
+
+    for dev_id, stats in sorted(mem.items(), key=_dev_key):
+        in_use = stats.get("bytes_in_use")
+        peak = stats.get("peak_bytes_in_use")
+        limit = stats.get("bytes_limit")
+        if in_use is None and peak is None:
+            continue
+        line = f"hbm[{dev_id}]:"
+        if in_use is not None:
+            line += f" {in_use / 2**30:.2f} GiB in use"
+        if peak is not None:
+            line += f" / {peak / 2**30:.2f} GiB peak"
+        if limit is not None:
+            line += f" (limit {limit / 2**30:.2f} GiB)"
+        lines.append(line)
+
+    if report.get("compile_report") and (report.get("oom") or events):
+        # the budget table IS the OOM forensics payload; on a healthy
+        # explicit dump it stays out of the way unless compiles fired
+        from apex_tpu.monitor.compile import report as compile_report
+        try:
+            lines.append(compile_report.render_budget_table(
+                report["compile_report"]))
+        except Exception as e:  # a drifted attachment must not cost
+            lines.append(f"(compile report unrenderable: {e!r})")
 
     last_good = None
     first_bad = None
@@ -151,6 +199,11 @@ def render_report(report: dict, last: Optional[int] = None) -> str:
         lines.append(line)
 
     lines.append("--- verdict ---")
+    if report.get("oom"):
+        lines.append(
+            "death by RESOURCE_EXHAUSTED: compare the HBM budget "
+            "table above against the device limit (shrink the batch, "
+            "enable remat, or shard the optimizer state)")
     if first_bad is None:
         lines.append("no non-finite step in the recorded window")
     else:

@@ -206,6 +206,17 @@ def make_train_step(loss_fn: Callable, optimizer, mesh, *,
           -> (opt_state, scaler_state[, model_state], loss[, aux],
               metrics, tap_state, rank_timings)
 
+    The returned step also carries the compile & HBM observatory
+    handles (apex_tpu.monitor.compile): `step.lower(*args)` lowers
+    through the same argument mapping as a call (so
+    `monitor.analyze_step(step, args)` AOT-audits the EXACT program
+    that will run — HBM budget, donation check, flops cross-check,
+    without executing), `step.jitted` exposes the underlying jit for
+    the RecompileSentry's cache poll, and `step.donate_argnums` /
+    `step.arg_names` label the audit.  None of these touch the
+    compiled program — numerics are bitwise identical whether or not
+    the step was analyzed (tests/test_compile_report.py).
+
     ≡ the reference hot loop: DDP.forward → amp.scale_loss → backward
     hooks/allreduce → FusedAdam.step (SURVEY §3.2-3.3), collapsed into
     one compiled program.
@@ -488,18 +499,54 @@ def make_train_step(loss_fn: Callable, optimizer, mesh, *,
     donate_args = (0,) if donate else ()
     jitted = jax.jit(smapped, donate_argnums=donate_args)
 
+    # compile & HBM observatory labels (ISSUE 5): the budget classifier
+    # of monitor.compile.analyze_step keys on these names
+    names = ["opt_state", "scaler_state"]
+    if with_state:
+        names.append("model_state")
+    names.append("batch")
+    if metrics_cfg is not None:
+        names.append("metrics_state")
+    if trace_cfg is not None and trace_cfg.rank_timing:
+        names.append("local_timing")
+
     if with_state and metrics_cfg is None and trace_cfg is None:
-        return jitted  # the exact pre-metrics/pre-trace callable
+        # the exact pre-metrics/pre-trace callable.  jax's jit wrapper
+        # takes attributes, so the observatory handles ride along; if a
+        # jaxlib ever refuses, the audit still works via analyze_step's
+        # explicit donated=/arg_names= arguments.
+        try:
+            jitted.donate_argnums = donate_args
+            jitted.arg_names = tuple(names)
+        except AttributeError:  # pragma: no cover
+            pass
+        return jitted
 
     if with_state:
         def step(opt_state, scaler_state, model_state, batch, *extra):
             return jitted(opt_state, scaler_state, model_state, batch,
                           *extra)
+
+        def lower(opt_state, scaler_state, model_state, batch, *extra):
+            return jitted.lower(opt_state, scaler_state, model_state,
+                                batch, *extra)
     else:
         def step(opt_state, scaler_state, batch, *extra):
             return jitted(opt_state, scaler_state, None, batch, *extra)
 
+        def lower(opt_state, scaler_state, batch, *extra):
+            return jitted.lower(opt_state, scaler_state, None, batch,
+                                *extra)
+
     # flight-recorder label access: the ordered tap names, known after
     # the tapped loss first traces (None before the first call)
     step.tap_names = lambda: tap_holder["names"]
+    # AOT observatory handles (monitor.compile.analyze_step): .lower
+    # applies the call path's argument mapping, .jitted lets the
+    # RecompileSentry poll the real jit cache, donate_argnums/arg_names
+    # drive the donation check and the budget table labels
+    step.lower = lower
+    step.jitted = jitted
+    step.donate_argnums = donate_args
+    step.arg_names = tuple(names)
     return step

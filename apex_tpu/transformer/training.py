@@ -116,11 +116,32 @@ def make_tp_dp_train_step(model, optimizer, mesh, *,
     # re-specializes per input shape/dtype on its own
     cache = {}
 
-    def step(opt_state, tokens, labels):
+    def _jitted_for(opt_state):
         k = jax.tree_util.tree_structure(opt_state)
         fn = cache.get(k)
         if fn is None:
             fn = cache[k] = build(opt_state)
-        return fn(opt_state, tokens, labels)
+        return fn
 
+    def step(opt_state, tokens, labels):
+        return _jitted_for(opt_state)(opt_state, tokens, labels)
+
+    def lower(opt_state, tokens, labels):
+        return _jitted_for(opt_state).lower(opt_state, tokens, labels)
+
+    def _cache_size():
+        # aggregate over the per-structure jits so RecompileSentry's
+        # cache poll sees EVERY compile — including the donated-layout
+        # recompile no argument-signature change announces (without
+        # this the sentry falls back to signature-only detection and
+        # the bench gate would miss that class entirely)
+        return sum(fn._cache_size() for fn in cache.values())
+
+    # compile & HBM observatory handles (monitor.compile.analyze_step
+    # / RecompileSentry): AOT-audit the exact program, label the
+    # budget table, verify donation — see parallel/ddp.py
+    step.lower = lower
+    step._cache_size = _cache_size
+    step.donate_argnums = (0,) if donate else ()
+    step.arg_names = ("opt_state", "tokens", "labels")
     return step

@@ -55,20 +55,47 @@ def _retry(fn, *args, attempts=3):
     raise last
 
 
-def _time_steps(step, state, tokens, labels, iters, warmup):
+# per-config RecompileSentry summaries, stamped into the result JSON as
+# "n_compiles" (ISSUE 5 satellite): a config whose steady state
+# recompiles is measuring XLA, not training, and _time_steps raises
+_SENTRY = {}
+
+
+def _time_steps(step, state, tokens, labels, iters, warmup, name=None):
+    from apex_tpu.monitor.compile import RecompileSentry
+
+    sentry = RecompileSentry(step, name=name or "bench", warn=False)
     for _ in range(warmup):
-        state, loss = step(state, tokens, labels)
+        state, loss = sentry(state, tokens, labels)
+    # the sentry replaces the old hand-rolled "warmup 2: donated-state
+    # second compile" dance: keep warming (bounded) while the last call
+    # still compiled, whatever the reason — layout recompiles included
+    extra = 0
+    while (extra < 3 and sentry.events
+           and sentry.events[-1]["call"] == sentry.calls):
+        state, loss = sentry(state, tokens, labels)
+        extra += 1
     _ = np.asarray(loss)  # full sync (block_until_ready is unreliable
     # through the remote-tunnel backend)
+    sentry.mark_steady()
     t0 = time.perf_counter()
     for _ in range(iters):
-        state, loss = step(state, tokens, labels)
+        state, loss = sentry(state, tokens, labels)
     _ = np.asarray(loss)
-    return (time.perf_counter() - t0) / iters
+    dt = (time.perf_counter() - t0) / iters
+    if name:
+        _SENTRY[name] = sentry.summary()
+    if sentry.steady_recompiles:
+        raise RuntimeError(
+            f"{name or 'bench'}: {sentry.steady_recompiles} steady-state"
+            f" recompile(s) during the timed window — the measurement is"
+            f" compilation, not training; last signature: "
+            f"{sentry.events[-1]['signature'][:120]}")
+    return dt
 
 
 def _fused_tokens_per_sec(on_tpu, batch, seq, cfg,
-                          master_dtype=jnp.float32):
+                          master_dtype=jnp.float32, name="gpt350m"):
     from apex_tpu.models.gpt import GPT
     from apex_tpu.optimizers.fused_adam import FusedAdam
     from apex_tpu.parallel import mesh as M
@@ -90,7 +117,8 @@ def _fused_tokens_per_sec(on_tpu, batch, seq, cfg,
                                 cfg.vocab_size)
     labels = jnp.roll(tokens, -1, axis=1)
     iters, warmup = (20, 3) if on_tpu else (3, 1)
-    dt = _time_steps(step, opt_state, tokens, labels, iters, warmup)
+    dt = _time_steps(step, opt_state, tokens, labels, iters, warmup,
+                     name=name)
     M.destroy_model_parallel()
     return batch * seq / dt
 
@@ -153,10 +181,14 @@ def _baseline_tokens_per_sec(on_tpu, batch, seq, cfg_fused):
     tokens = jax.random.randint(jax.random.PRNGKey(1), (batch, seq), 0,
                                 cfg.vocab_size)
     labels = jnp.roll(tokens, -1, axis=1)
-    # warmup 2: the first donated-state call can trigger a second
-    # compile when output layouts differ from the initial inputs
-    iters, warmup = (3, 2) if on_tpu else (2, 1)
-    dt = _time_steps(step, state, tokens, labels, iters, warmup)
+    # the recompile sentry inside _time_steps handles the donated-state
+    # second compile (output layouts differing from the initial inputs)
+    # by extending warmup while calls still compile — no hand-rolled
+    # "warmup 2" needed, and a steady-state recompile now raises
+    # instead of silently polluting the measurement
+    iters, warmup = (3, 1) if on_tpu else (2, 1)
+    dt = _time_steps(step, state, tokens, labels, iters, warmup,
+                     name="baseline")
     M.destroy_model_parallel()
     return batch * seq / dt
 
@@ -234,7 +266,8 @@ def _gpt1p3b_tokens_per_sec(on_tpu):
                         num_layers=2, num_heads=4, dropout=0.0,
                         remat=True, remat_policy="dots")
     return _fused_tokens_per_sec(on_tpu, batch, seq, cfg,
-                                 master_dtype=jnp.bfloat16)
+                                 master_dtype=jnp.bfloat16,
+                                 name="gpt1p3b")
 
 
 def _bert_seq_per_sec(on_tpu):
@@ -286,7 +319,8 @@ def _bert_seq_per_sec(on_tpu):
     step = make_tp_dp_train_step(model, opt, mesh, loss_fn=loss_fn,
                                  donate=True)
     iters, warmup = (10, 2) if on_tpu else (2, 1)
-    dt = _time_steps(step, opt_state, tokens, mlm_labels, iters, warmup)
+    dt = _time_steps(step, opt_state, tokens, mlm_labels, iters, warmup,
+                     name="bert")
     M.destroy_model_parallel()
     return batch / dt
 
@@ -503,6 +537,42 @@ def _run_isolated(metric):
         f"no JSON line containing {metric!r} in --only child stdout")
 
 
+def _compile_audit_350m(on_tpu, batch, seq, cfg, master_dtype):
+    """AOT compile & HBM audit of the flagship step (ISSUE 5): the
+    memory/cost anatomy + the donation check + the flops cross-check
+    that validates the MFU numbers derived from the flagship metric.
+    master_dtype MUST be what main() passed `_fused_tokens_per_sec` —
+    the audit only has value if it compiles the SAME program the
+    flagship metric timed.  Runs in its OWN timed block —
+    `analyze_step`'s lower().compile() does not seed the jit cache, so
+    folding it into the flagship window would add a full duplicate XLA
+    compile to a duration trajectory the bench keeps comparable across
+    rounds."""
+    from apex_tpu import monitor
+    from apex_tpu.models.gpt import GPT
+    from apex_tpu.optimizers.fused_adam import FusedAdam
+    from apex_tpu.parallel import mesh as M
+    from apex_tpu.transformer.training import (
+        init_sharded_optimizer,
+        make_tp_dp_train_step,
+    )
+
+    M.destroy_model_parallel()
+    mesh = M.initialize_model_parallel(devices=jax.devices()[:1])
+    model = GPT(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+    opt = FusedAdam(lr=1e-4, use_pallas=on_tpu, master_dtype=master_dtype)
+    opt_state = init_sharded_optimizer(opt, model, params, mesh)
+    step = make_tp_dp_train_step(model, opt, mesh, donate=True)
+    del params
+    tok = jax.ShapeDtypeStruct((batch, seq), jnp.int32)
+    rep = monitor.analyze_step(
+        step, (opt_state, tok, tok),
+        analytic_flops=monitor.gpt_step_flops(cfg, batch))
+    M.destroy_model_parallel()
+    return rep.to_dict()
+
+
 _ONLY = {
     "resnet50_img_per_sec": lambda on_tpu: round(
         _retry(_resnet50_img_per_sec, on_tpu), 1),
@@ -583,9 +653,12 @@ def main():
                         num_layers=2, num_heads=4, dropout=0.0)
 
     durations = {}
+    # ONE master-dtype decision, shared by the flagship metric and its
+    # compile audit — the audit must compile the same program it audits
+    master_dtype = jnp.bfloat16 if on_tpu else jnp.float32
     with _timed(durations, "gpt350m_train_tokens_per_sec_per_chip"):
         fused = _retry(_fused_tokens_per_sec, on_tpu, batch, seq, cfg,
-                       jnp.bfloat16 if on_tpu else jnp.float32)
+                       master_dtype)
     result = {
         "metric": "gpt350m_train_tokens_per_sec_per_chip",
         "value": round(fused, 1),
@@ -669,6 +742,26 @@ def main():
     # trajectories comparable as metrics are added across rounds
     result["monitor_schema_version"] = SCHEMA_VERSION
     result["metric_durations_s"] = durations
+    # compile & HBM observatory (ISSUE 5): the flagship step's AOT
+    # memory/cost anatomy (argument/temp/alias bytes, donation check,
+    # flops cross-check vs monitor.flops), per-config recompile-sentry
+    # summaries, and the device-memory high-water mark after the run
+    try:
+        with _timed(durations, "compile_audit"):
+            result["compile_audit"] = _retry(
+                _compile_audit_350m, on_tpu, batch, seq, cfg,
+                master_dtype)
+    except Exception as e:
+        result["compile_audit_error"] = repr(e)[:120]
+    if _SENTRY:
+        result["n_compiles"] = {k: v["n_compiles"]
+                                for k, v in _SENTRY.items()}
+        result["recompile_sentry"] = _SENTRY
+    try:
+        from apex_tpu.monitor.compile import hbm_watermarks
+        result["hbm"] = hbm_watermarks()
+    except Exception as e:
+        result["hbm_error"] = repr(e)[:120]
     # tuner cache state (ISSUE 3): which tuned configs were active and
     # how often the kernels hit them — runs with different fingerprints
     # are not comparing the same kernels

@@ -89,23 +89,32 @@ def main():
                                batch_spec=(P("dp"), P("dp")),
                                metrics=True, trace=trace_cfg)
 
-    tokens_per_step = args.batch * cfg.seq_len
-    # MFU convention: GLOBAL-batch FLOPs over the AGGREGATE peak of all
-    # dp chips — without the dp factor a multi-chip run reads dp-times
-    # too high (each chip computes 1/dp of the global FLOPs)
-    logger = monitor.MetricsLogger(
-        [monitor.JSONLSink(args.jsonl), monitor.ConsoleSink()],
-        flops_per_step=monitor.gpt_step_flops(cfg, args.batch),
-        peak_flops=monitor.V5E_BF16_PEAK * dp,
-        taps=flight)
-    metrics = monitor.init_metrics()
-    timers = Timers()
-
     recorder = None
     if flight:
         recorder = monitor.FlightRecorder(
             args.flight_report, capacity=args.flight_capacity,
             straggler=monitor.StragglerDetector())
+
+    # the compile & HBM observatory (ISSUE 5): the sentry counts
+    # traces/compiles (events land in the flight-recorder ring), the
+    # logger stamps n_compiles + the hbm_* watermarks (null on CPU —
+    # schema-legal) into every record
+    sentry = monitor.RecompileSentry(step, recorder=recorder)
+    step = sentry
+
+    tokens_per_step = args.batch * cfg.seq_len
+    # MFU convention: GLOBAL-batch FLOPs over the AGGREGATE peak of all
+    # dp chips — without the dp factor a multi-chip run reads dp-times
+    # too high (each chip computes 1/dp of the global FLOPs).
+    # device_peak_flops() resolves the per-chip peak from the device
+    # kind (v4/v5e/v5p table; V5E fallback elsewhere).
+    logger = monitor.MetricsLogger(
+        [monitor.JSONLSink(args.jsonl), monitor.ConsoleSink()],
+        flops_per_step=monitor.gpt_step_flops(cfg, args.batch),
+        peak_flops=monitor.device_peak_flops() * dp,
+        taps=flight, sentry=sentry, memory=True)
+    metrics = monitor.init_metrics()
+    timers = Timers()
 
     cap = (monitor.profile_capture(range(1, 3), logdir=args.profile_dir)
            if args.profile_dir else monitor.ProfileCapture(()))
@@ -139,6 +148,23 @@ def main():
     scaler_box = [scaler]
     prev_durations = (0.0, 0.0)
 
+    if flight:
+        # AOT compile audit of the exact step about to run (compiles
+        # without executing): the crash dump then carries the HBM
+        # budget table — the OOM-forensics payload
+        try:
+            _, audit_batch = make_batch(jax.random.PRNGKey(0))
+            audit_args = (opt_state_box[0], scaler_box[0], audit_batch,
+                          metrics,
+                          jnp.asarray(np.tile(
+                              np.asarray(prev_durations, np.float32),
+                              (dp, 1))))
+            recorder.attach_compile_report(monitor.analyze_step(
+                sentry, audit_args,
+                analytic_flops=monitor.gpt_step_flops(cfg, args.batch)))
+        except Exception as e:  # audit is advisory, never fatal
+            print(f"compile audit unavailable: {e!r}")
+
     # two unlogged warmup steps, then restart the rate window: without
     # them the first record's step_time/tokens-per-sec/MFU measure jit
     # compilation, not training (two because the first donated-state
@@ -150,6 +176,9 @@ def main():
         opt_state_box[0], scaler_box[0], _, metrics = out[:4]
     jax.block_until_ready(opt_state_box[0])
     logger.reset_timer(metrics)  # resync step/token baselines too
+    sentry.mark_steady()  # compiles were expected until here; any
+    # further one is a silent retrace — warned once, visible as
+    # n_compiles in the JSONL and as an event in the flight ring
 
     with (recorder.guard() if flight else cap):
         for i in range(args.steps):

@@ -39,6 +39,12 @@ _FIXTURE_MARKERS = (
     "STRAGGLER rank 2",
     "last good step: 41001",
     "first bad step: 41002",
+    # the compile & HBM observatory plane (ISSUE 5): the steady-state
+    # retrace, the device watermark, and the HBM budget table
+    "RECOMPILE at call 40970",
+    "hbm[0]: 13.50 GiB in use / 14.00 GiB peak",
+    "=== HBM budget ===",
+    "donation: ok",
 )
 
 

@@ -31,6 +31,8 @@ Usage:
   python scripts/gpt_anatomy.py tune [targets...]          # autotune + re-emit roofline
   python scripts/gpt_anatomy.py tune --check [targets...]  # verify committed defaults
                                                            # (nonzero exit on drift)
+  python scripts/gpt_anatomy.py mem [targets...]           # AOT HBM budget tables
+                                                           # (compile only, no execute)
 
 `tune` drives apex_tpu.tune.search over each target's flash shape (and
 the flat-Adam block at the 1B point), writes the winners to the
@@ -431,6 +433,111 @@ def tune_mode(targets, check=False):
     return 0
 
 
+# --------------------------- AOT memory anatomy ---------------------------
+
+def mem_mode(targets):
+    """Per-target HBM budget via the compile observatory (ISSUE 5):
+    build the EXACT bench train step for each config, AOT lower+compile
+    it WITHOUT executing, and print the budget table (params /
+    optimizer state / activations+temps), the donation check, and the
+    flops cross-check against monitor.flops' analytic accounting — the
+    table an operator reads before picking a batch size.  On a CPU
+    backend the big configs would take minutes of XLA compile for no
+    memory truth, so a tiny smoke config substitutes (the table
+    structure and checks still exercise end to end)."""
+    import jax.numpy as jnp
+
+    from apex_tpu import monitor
+    from apex_tpu.models.bert import Bert, BertConfig
+    from apex_tpu.models.gpt import GPT, GPTConfig
+    from apex_tpu.optimizers.fused_adam import FusedAdam
+    from apex_tpu.optimizers.fused_lamb import FusedLAMB
+    from apex_tpu.parallel import mesh as M
+    from apex_tpu.transformer.training import (
+        init_sharded_optimizer,
+        make_tp_dp_train_step,
+    )
+
+    on_tpu = jax.default_backend() not in ("cpu",)
+    rc = 0
+    for t in targets:
+        nm, h, L, H, b, s, v, c = CONFIGS[t]
+        is_bert = not c  # the one bidirectional bench config
+        if on_tpu:
+            batch = b
+        else:
+            # CPU: the big configs cost minutes of XLA compile for no
+            # memory truth — shrink to smoke size but KEEP the model
+            # family so every target's build path stays exercised
+            print(f"--- mem {nm}: CPU backend, shrinking to the smoke "
+                  "config (structure only; run on TPU for real bytes)",
+                  flush=True)
+            h, L, H, v = 64, 2, 4, 512
+            batch, s = 2, 64
+        M.destroy_model_parallel()
+        mesh = M.initialize_model_parallel(devices=jax.devices()[:1])
+        loss_fn = None
+        if is_bert:
+            # mirror bench._bert_seq_per_sec: BERT-Large MLM+NSP step
+            # with FusedLAMB — the budget must be of the EXACT program
+            # the bench times, not a causal GPT stand-in
+            cfg = BertConfig(vocab_size=v, seq_len=s, hidden=h,
+                             num_layers=L, num_heads=H,
+                             dtype=jnp.bfloat16 if on_tpu
+                             else jnp.float32,
+                             use_flash_attention=on_tpu)
+            model = Bert(cfg)
+            loss_mask = jnp.zeros((batch, s), bool)
+            nsp = jnp.zeros((batch,), jnp.int32)
+
+            def loss_fn(p, tk, lb):
+                return model.loss(p, tk, lb, loss_mask, nsp_labels=nsp)
+
+            opt = FusedLAMB(lr=1e-4, weight_decay=0.01,
+                            use_pallas=on_tpu,
+                            master_dtype=jnp.bfloat16 if on_tpu
+                            else jnp.float32)
+            analytic = monitor.bert_step_flops(cfg, batch, seq=s)
+        else:
+            cfg = (GPTConfig(vocab_size=v, seq_len=s, hidden=h,
+                             num_layers=L, num_heads=H, dropout=0.0,
+                             dtype=jnp.bfloat16,
+                             logits_dtype=jnp.bfloat16, remat=False,
+                             use_flash_attention=True) if on_tpu else
+                   GPTConfig(vocab_size=v, seq_len=s, hidden=h,
+                             num_layers=L, num_heads=H, dropout=0.0))
+            model = GPT(cfg)
+            opt = FusedAdam(lr=1e-4, use_pallas=on_tpu,
+                            master_dtype=jnp.bfloat16 if on_tpu
+                            else jnp.float32)
+            analytic = monitor.gpt_step_flops(cfg, batch, seq=s)
+        params = model.init(jax.random.PRNGKey(0))
+        opt_state = init_sharded_optimizer(opt, model, params, mesh)
+        step = make_tp_dp_train_step(model, opt, mesh, loss_fn=loss_fn,
+                                     donate=True)
+        del params
+        tokens = jax.ShapeDtypeStruct((batch, s), jnp.int32)
+        labels = jax.ShapeDtypeStruct((batch, s), jnp.int32)
+        print(f"\n--- mem {nm}: h{h} L{L} H{H} b{batch} s{s} "
+              f"(AOT, no execution)", flush=True)
+        rep = monitor.analyze_step(step, (opt_state, tokens, labels),
+                                   analytic_flops=analytic)
+        print(monitor.render_budget_table(rep), flush=True)
+        if on_tpu and (rep.donation_ok is False or rep.flops_ok is False):
+            # a flagged budget is a failed gate, CI-style — but only
+            # for the REAL configs; the CPU smoke substitution's flop
+            # mix legitimately diverges (NSP/pooler residue at tiny h)
+            rc = 1
+        M.destroy_model_parallel()
+    live = monitor.device_memory_stats()
+    if live is not None:
+        print(f"\nlive allocator: "
+              f"{live.get('bytes_in_use', 0) / 2**30:.2f} GiB in use, "
+              f"{live.get('peak_bytes_in_use', 0) / 2**30:.2f} GiB peak",
+              flush=True)
+    return rc
+
+
 CONFIGS = {
     # name: (hidden, layers, heads, batch, seq, vocab, causal)
     "350m": ("GPT-350M", 1024, 24, 16, 12, 1024, 50304, True),
@@ -462,6 +569,13 @@ if __name__ == "__main__":
             sys.exit(f"unknown tune target(s) {bad}; "
                      f"choices: {sorted(CONFIGS)}")
         sys.exit(tune_mode(targets, check=check))
+    elif which == "mem":
+        targets = sys.argv[2:] or ["350m"]
+        bad = [t for t in targets if t not in CONFIGS]
+        if bad:
+            sys.exit(f"unknown mem target(s) {bad}; "
+                     f"choices: {sorted(CONFIGS)}")
+        sys.exit(mem_mode(targets))
     elif which == "blocks":
         flash_block_sweep(causal=False)   # BERT shape
         flash_block_sweep(batch=7, heads=32, seq=512, causal=True)  # 1.3B
@@ -476,4 +590,4 @@ if __name__ == "__main__":
     else:
         sys.exit(f"unknown mode {which!r}; expected one of "
                  f"{sorted(CONFIGS)} | both | roofline [target...] | "
-                 "blocks | tune [--check] [target...]")
+                 "blocks | tune [--check] [target...] | mem [target...]")

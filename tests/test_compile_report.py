@@ -1,0 +1,280 @@
+"""Compile & HBM observatory tests (ISSUE 5): `analyze_step` ->
+`CompileReport` under a CPU backend (optional backend fields None, no
+crash), donation verification (a deliberately un-donated buffer is
+flagged), the flops-accounting cross-check (a seeded divergence is
+flagged), the recompile sentry (an induced shape-change retrace is
+caught), crash-dump attachment of the report, and the acceptance line:
+`ddp.make_train_step` numerics are bitwise identical with the
+observatory on vs off.
+
+Everything here runs tiny jits — the whole file must stay cheap (the
+tier-1 window is a dot budget; this file sorts early in the alphabet).
+"""
+
+import json
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu import monitor
+from apex_tpu.monitor import compile as obs
+from apex_tpu.monitor import trace
+from apex_tpu.optimizers.fused_adam import FusedAdam
+from apex_tpu.parallel import ddp
+from apex_tpu.parallel import mesh as M
+
+
+# ----------------------------- analyze_step -----------------------------
+
+def _donating_fn():
+    return jax.jit(lambda s, x: (s + x, (s * x).sum()),
+                   donate_argnums=(0,))
+
+
+def test_analyze_step_populated_on_cpu():
+    """Acceptance: a populated CompileReport under JAX_PLATFORMS=cpu —
+    backend fields that CPU XLA does report are ints, device memory is
+    None, nothing crashes, and the dict form is JSON-serializable."""
+    f = _donating_fn()
+    s = jnp.ones((64, 64))
+    rep = obs.analyze_step(f, (s, s), donated=(0,),
+                           arg_names=("opt_state", "batch"))
+    assert rep.backend == "cpu"
+    assert isinstance(rep.argument_bytes, int) and rep.argument_bytes > 0
+    assert isinstance(rep.flops, float) and rep.flops > 0
+    assert rep.arg_bytes == {"opt_state": 64 * 64 * 4,
+                             "batch": 64 * 64 * 4}
+    # CPU allocator does not report: watermark fields None, no crash
+    assert obs.device_memory_stats() is None
+    wm = obs.hbm_watermarks()
+    assert wm == {"hbm_bytes_in_use": None,
+                  "hbm_peak_bytes_in_use": None,
+                  "hbm_bytes_limit": None}
+    json.dumps(rep.to_dict())  # the crash-dump attachment form
+    text = obs.render_budget_table(rep)
+    assert "HBM budget" in text
+
+
+def test_analyze_step_accepts_shape_structs():
+    """The audit never needs device buffers: ShapeDtypeStructs lower
+    and compile the same program."""
+    f = _donating_fn()
+    sds = jax.ShapeDtypeStruct((32, 32), jnp.float32)
+    rep = obs.analyze_step(f, (sds, sds), donated=(0,))
+    assert rep.donated_bytes == 32 * 32 * 4
+    assert rep.donation_ok is True
+
+
+def test_donation_verification_flags_undonated():
+    """The 'second state copy alive' failure: claiming donation on a
+    jit that does NOT donate must flag — donated bytes never show up
+    as output aliasing."""
+    f_nodonate = jax.jit(lambda s, x: (s + x, (s * x).sum()))
+    s = jnp.ones((64, 64))
+    rep = obs.analyze_step(f_nodonate, (s, s), donated=(0,))
+    assert rep.donation_ok is False
+    assert rep.undonated_bytes == rep.donated_bytes > 0
+    assert "DONATION FAILED" in obs.render_budget_table(rep)
+    # and the donating twin of the same program verifies clean
+    ok = obs.analyze_step(_donating_fn(), (s, s), donated=(0,))
+    assert ok.donation_ok is True and ok.undonated_bytes == 0
+
+
+def test_flops_crosscheck_flags_seeded_divergence():
+    """A matmul whose analytic count is correct passes; the same
+    program scored against a 3x-wrong analytic count is flagged —
+    the gate that validates every published MFU number."""
+    m = k = n = 128
+    f = jax.jit(lambda a, b: a @ b)
+    a = jnp.ones((m, k))
+    b = jnp.ones((k, n))
+    good = obs.analyze_step(f, (a, b), analytic_flops=2 * m * k * n)
+    assert good.flops_ok is True
+    assert good.flops_divergence < 0.10
+    bad = obs.analyze_step(f, (a, b), analytic_flops=6 * m * k * n)
+    assert bad.flops_ok is False
+    assert "FLOPS ACCOUNTING DIVERGES" in obs.render_budget_table(bad)
+
+
+def test_analyze_step_rejects_unloweable():
+    with pytest.raises(TypeError, match="lower"):
+        obs.analyze_step(lambda x: x, (jnp.ones(3),))
+
+
+# --------------------------- recompile sentry ---------------------------
+
+def test_sentry_catches_induced_retrace():
+    """Acceptance: an induced shape-change retrace is caught, its
+    signature recorded, and — after mark_steady — warned once and
+    counted as a steady-state recompile."""
+    sent = obs.RecompileSentry(jax.jit(lambda x: x * 2), name="t")
+    sent(jnp.ones(4))
+    sent(jnp.ones(4))                       # cache hit: no new compile
+    assert sent.n_compiles == 1 and sent.calls == 2
+    sent.mark_steady()
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        sent(jnp.ones(8))                   # the induced retrace
+        sent(jnp.ones(16))                  # second one: no new warning
+    assert sent.n_compiles == 3
+    assert sent.steady_recompiles == 2
+    assert len([x for x in w if issubclass(x.category,
+                                           RuntimeWarning)]) == 1
+    ev = sent.events[-1]
+    assert ev["steady_state"] and "(16,)" in ev["signature"]
+    assert sent.summary()["n_compiles"] == 3
+
+
+def test_sentry_events_land_in_flight_ring(tmp_path):
+    rec = trace.FlightRecorder(tmp_path / "f.json", capacity=2)
+    sent = obs.RecompileSentry(jax.jit(lambda x: x + 1), recorder=rec,
+                               warn=False)
+    sent(jnp.ones(4))
+    rep = rec.report()
+    assert len(rep["compile_events"]) == 1
+    assert rep["compile_events"][0]["call"] == 1
+    trace.validate_report(rep)
+
+
+# ----------------------- ddp train-step integration -----------------------
+
+def _linear_step(mesh, metrics=None):
+    X = jnp.asarray(np.random.default_rng(0).normal(size=(32, 4)),
+                    jnp.float32)
+    Y = X @ jnp.asarray([[1.0], [-2.0], [0.5], [3.0]])
+
+    def loss_fn(p, b):
+        x, y = b
+        return jnp.mean((x @ p["w"] - y) ** 2)
+
+    opt = FusedAdam(lr=0.05, use_pallas=False)
+    state = opt.init({"w": jnp.zeros((4, 1))})
+    step = ddp.make_train_step(loss_fn, opt, mesh,
+                               batch_spec=(P("dp"), P("dp")),
+                               metrics=metrics)
+    return step, state, (X, Y)
+
+
+def test_ddp_step_audits_and_stays_bitwise_identical():
+    """Acceptance: analyze_step on the make_train_step handles works
+    (budget classified by arg name, donation verified) AND training
+    is bitwise identical whether or not the observatory ran."""
+    mesh = M.initialize_model_parallel()
+    step, state, batch = _linear_step(mesh)
+    assert step.arg_names == ("opt_state", "scaler_state", "batch")
+    assert step.donate_argnums == (0,)
+    rep = obs.analyze_step(step, (state, None, batch))
+    assert rep.budget["params"] > 0
+    assert rep.budget["optimizer_state"] > rep.budget["params"]
+    assert rep.donation_ok is True
+
+    # plain run vs audited + sentry-wrapped run: same bits out.  The
+    # audit above only LOWERED (no execution) — `state` is untouched
+    # and safe to train from; `plain` is a separately-built twin with
+    # its own identically-initialized state.
+    plain, s_plain, _ = _linear_step(mesh)
+    for _ in range(3):
+        s_plain, _, _ = plain(s_plain, None, batch)
+    sent = obs.RecompileSentry(step, warn=False)
+    s_obs = state
+    for _ in range(3):
+        s_obs, _, _ = sent(s_obs, None, batch)
+    a = np.asarray(jax.device_get(s_plain.params))
+    b = np.asarray(jax.device_get(s_obs.params))
+    assert a.tobytes() == b.tobytes(), "observatory changed numerics"
+    assert sent.n_compiles >= 1 and sent.steady_recompiles == 0
+
+
+def test_logger_stamps_observatory_fields(tmp_path):
+    """MetricsLogger(sentry=, memory=True): n_compiles + null hbm_*
+    fields in the record, schema-valid (v3 optional fields)."""
+    sent = obs.RecompileSentry(jax.jit(lambda x: x), warn=False)
+    sent(jnp.ones(2))
+    path = tmp_path / "m.jsonl"
+    logger = monitor.MetricsLogger([monitor.JSONLSink(path)],
+                                   sentry=sent, memory=True)
+    m = monitor.init_metrics()._replace(step=jnp.asarray(1, jnp.int32))
+    rec = logger.log_step(m)
+    logger.close()
+    assert rec["n_compiles"] == 1
+    assert rec["hbm_bytes_in_use"] is None  # CPU: null, schema-legal
+    (line,) = path.read_text().splitlines()
+    monitor.validate_record(json.loads(line))
+
+
+def test_validate_record_rejects_bad_observatory_fields():
+    base = {"monitor_schema_version": monitor.SCHEMA_VERSION, "step": 1,
+            "loss": 1.0, "grad_norm": 0.1, "param_norm": 1.0,
+            "update_norm": 0.0, "loss_scale": 1.0, "overflow_count": 0,
+            "skipped_steps": 0, "tokens_seen": 0.0, "step_time_ms": 1.0,
+            "tokens_per_sec": 1.0, "mfu": 0.0}
+    monitor.validate_record(dict(base, n_compiles=2,
+                                 hbm_bytes_in_use=None))
+    with pytest.raises(ValueError, match="n_compiles"):
+        monitor.validate_record(dict(base, n_compiles=None))
+    with pytest.raises(ValueError, match="hbm_bytes_in_use"):
+        monitor.validate_record(dict(base, hbm_bytes_in_use=1.5))
+    with pytest.raises(ValueError, match="scalar"):
+        monitor.validate_record(dict(base, hbm_custom={"nested": 1}))
+
+
+# --------------------------- crash-dump forensics ---------------------------
+
+def test_crash_dump_attaches_report_and_classifies_oom(tmp_path):
+    """Acceptance: guard() on a RESOURCE_EXHAUSTED death dumps with
+    oom=true, the attached CompileReport, and the budget table renders
+    from the artifact."""
+    f = _donating_fn()
+    s = jnp.ones((16, 16))
+    rep = obs.analyze_step(f, (s, s), donated=(0,))
+    path = tmp_path / "flight.json"
+    rec = trace.FlightRecorder(path, capacity=4)
+    rec.attach_compile_report(rep)
+    rec.record(0, metrics={"step": 0, "loss": 1.0})
+    with pytest.raises(RuntimeError):
+        with rec.guard():
+            raise RuntimeError(
+                "RESOURCE_EXHAUSTED: Out of memory allocating 12GB")
+    data = json.loads(path.read_text())
+    trace.validate_report(data)
+    assert data["oom"] is True
+    assert data["compile_report"]["donation_ok"] is True
+    text = trace.render_report(data)
+    assert "OOM" in text and "HBM budget" in text
+    # a non-OOM death stays oom=false
+    with pytest.raises(ValueError):
+        with rec.guard():
+            raise ValueError("not an oom")
+    assert json.loads(path.read_text())["oom"] is False
+
+
+def test_validate_report_requires_observatory_fields(tmp_path):
+    rec = trace.FlightRecorder(tmp_path / "r.json", capacity=2)
+    rep = rec.report()
+    trace.validate_report(rep)
+    for missing in ("oom", "compile_report", "compile_events", "memory"):
+        with pytest.raises(ValueError, match="missing report field"):
+            trace.validate_report(
+                {k: v for k, v in rep.items() if k != missing})
+
+
+# ------------------------------ peak table ------------------------------
+
+def test_device_peak_flops_table_and_fallback():
+    assert monitor.device_peak_flops("TPU v4") == 275e12
+    assert monitor.device_peak_flops("TPU v5 lite") == 197e12
+    assert monitor.device_peak_flops("TPU v5e") == 197e12
+    assert monitor.device_peak_flops("TPU v5p") == 459e12
+    assert monitor.device_peak_flops("TPU v6 lite") == 918e12
+    # the documented fallback: unknown kinds (cpu) -> v5e peak, so
+    # existing numbers don't move
+    assert monitor.device_peak_flops("cpu") == monitor.V5E_BF16_PEAK
+    assert monitor.device_peak_flops() == monitor.V5E_BF16_PEAK
+    # explicit override wins outright
+    assert monitor.device_peak_flops("TPU v4", override=1e12) == 1e12
+    # mfu resolves the same table when peak_flops is omitted
+    assert monitor.mfu(monitor.V5E_BF16_PEAK, 1.0) == pytest.approx(1.0)
