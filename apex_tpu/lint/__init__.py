@@ -1,0 +1,62 @@
+"""apex_tpu.lint — static analysis of the traced/lowered train step.
+
+The standing correctness gate in front of execution (ISSUE 6): where
+the monitor stack *observes* the running program (telemetry, flight
+recorder, compile observatory), this package *verifies* the program
+before it runs — the veScale single-controller posture applied to the
+closed jaxpr.  Four passes:
+
+  dtype_policy — the static form of Apex's AMP cast lists: fp32 GEMMs
+                 in low-precision regions, lossy convert round trips,
+                 low-precision accumulation, non-fp32 master updates
+                 (DP1xx)
+  collectives  — unbound/mismatched mesh axes, psum-of-psum,
+                 loop-invariant collectives in scan bodies, fp16 psum
+                 overflow hazards, dead collectives (CL2xx)
+  donation     — donate_argnums coverage of the state arguments plus
+                 the runtime cross-check against
+                 `monitor.analyze_step`'s donation_ok (DN3xx)
+  hostsync     — the Python-AST retrace/host-sync pass: .item(),
+                 float(tracer), np.asarray, traced branching,
+                 jit-in-loop, loop-carried scalar closures inside
+                 jitted regions (HS4xx — the static complement of
+                 RecompileSentry)
+
+Entry points: `lint_step(step, args)` for built train steps (reads the
+builder-attached arg_names/donate_argnums/mesh_axis_names and traces
+the exact program), `lint_program(fn, args)` for bare jittables,
+`lint_paths([dirs])` for the source pass.  `scripts/lint_step.py` is
+the CI gate (nonzero exit on findings outside the committed allowlist,
+`scripts/lint_allowlist.txt`); findings also attach to
+`monitor.analyze_step(..., lint=True)` reports and ride into the
+flight-recorder crash dump with them.  See docs/lint.md for the rule
+catalog and the allowlist workflow.
+"""
+
+from apex_tpu.lint.engine import (  # noqa: F401
+    COLLECTIVE_PRIMS,
+    LOW_PRECISION,
+    LintConfig,
+    collect_views,
+    lint_program,
+    lint_step,
+    trace_jaxpr,
+)
+from apex_tpu.lint.findings import (  # noqa: F401
+    LINT_SCHEMA_VERSION,
+    RULES,
+    SEVERITIES,
+    Finding,
+    LintReport,
+    apply_allowlist,
+    load_allowlist,
+    make_finding,
+    parse_allowlist,
+    render_findings,
+    validate_findings,
+)
+from apex_tpu.lint.hostsync import (  # noqa: F401
+    lint_paths,
+    lint_source,
+    lint_source_text,
+)

@@ -1,0 +1,162 @@
+"""Collective lint — mesh-axis and collective-placement analysis.
+
+The SPMD program's collectives are fully visible in the jaxpr (psum /
+all_gather / reduce_scatter eqns with their axis names as params), so
+the properties veScale-style pre-execution verification wants are
+plain graph checks:
+
+  CL201  a collective over an axis the surrounding program does not
+         bind (or that the declared mesh does not carry) — the
+         mismatch that otherwise surfaces as an opaque trace error or,
+         worse, a silently wrong reduction on a renamed mesh.
+  CL202  psum-of-psum over the same axis: the second reduction
+         multiplies by the axis size (a pmean of pre-summed grads
+         keeps the SUM — the exact hazard ddp.sync_gradients documents)
+         or is pure redundant traffic.
+  CL203  a loop-invariant collective inside a `scan` body: every
+         iteration pays ICI latency for bytes that never change —
+         hoist it above the scan.
+  CL204  a float16 psum/reduce_scatter operand: under loss scaling the
+         summands are scaled by up to 2^15 and fp16 saturates at
+         65504; the overflow happens INSIDE the collective where no
+         finite-check sees it.  bf16 carries fp32's exponent and is
+         exempt.
+  CL205  a dead collective (no consumer, not a program output): XLA
+         may DCE it, but its presence in the traced program means the
+         source builds a reduction it never uses — usually a stale
+         metrics line still paying a trace.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from apex_tpu.lint import engine as E
+from apex_tpu.lint.findings import Finding, make_finding
+
+# collectives that SUM their operand (the overflow-under-scaling class)
+_SUMMING = ("psum", "reduce_scatter", "psum_scatter")
+
+
+def _coll_axes(eqn):
+    """The axis names one collective eqn reduces over."""
+    axes = eqn.params.get("axes")
+    if axes is None:
+        axes = eqn.params.get("axis_name")
+    if axes is None:
+        return ()
+    if isinstance(axes, (tuple, list, frozenset, set)):
+        return tuple(str(a) for a in axes)
+    return (str(axes),)
+
+
+def _producer_through_scaling(prods, var, hops: int = 2):
+    """Walk back through elementwise scaling (div/mul by a scalar —
+    what pmean lowers to) to the producing eqn, so
+    psum(pmean(x)) is recognized as psum-of-psum."""
+    for _ in range(hops + 1):
+        if isinstance(var, E._Literal):
+            return None
+        eqn = prods.get(var)
+        if eqn is None:
+            return None
+        if eqn.primitive.name in ("div", "mul"):
+            var = eqn.invars[0]
+            continue
+        return eqn
+    return None
+
+
+def run(views, *, program: str, config: E.LintConfig) -> List[Finding]:
+    findings: List[Finding] = []
+    expected = (frozenset(str(a) for a in config.expected_axes)
+                if config.expected_axes is not None else None)
+
+    for view in views:
+        prods = E.producers(view.jaxpr)
+        used = E.used_vars(view.jaxpr)
+        inv = E.invariant_vars(view)
+        counts: dict = {}
+        for eqn in view.jaxpr.eqns:
+            prim = eqn.primitive.name
+            idx = counts.get(prim, 0)
+            counts[prim] = idx + 1
+            if prim not in E.COLLECTIVE_PRIMS:
+                continue
+            loc = view.eqn_location(program, eqn, idx)
+            axes = _coll_axes(eqn)
+
+            # ---- CL201: unbound / mismatched axis ----
+            # the axis must be bound by an enclosing shard_map/pmap
+            # scope (view.axes) when any is known, AND be carried by
+            # the declared mesh when the caller named one
+            for a in axes:
+                bound_ok = not view.axes or a in view.axes
+                declared_ok = expected is None or a in expected
+                if not bound_ok or not declared_ok:
+                    known = (sorted(view.axes) if not bound_ok
+                             else sorted(expected))
+                    findings.append(make_finding(
+                        "CL201", loc,
+                        f"{prim} reduces over axis {a!r} but the "
+                        f"{'program binds' if not bound_ok else 'declared mesh carries'}"
+                        f" only {known}",
+                        hint="bind the axis in the mesh/shard_map (or "
+                             "fix the axis_name typo); a collective "
+                             "over the wrong axis reduces the wrong "
+                             "ranks"))
+
+            # ---- CL202: psum-of-psum ----
+            if prim == "psum":
+                src = _producer_through_scaling(prods, eqn.invars[0])
+                if src is not None and src.primitive.name == "psum":
+                    src_axes = _coll_axes(src)
+                    overlap = set(axes) & set(src_axes)
+                    if overlap:
+                        findings.append(make_finding(
+                            "CL202", loc,
+                            f"psum over {sorted(overlap)} of a value "
+                            "already psum'd over the same axis — the "
+                            "second reduction multiplies by the axis "
+                            "size (or is pure redundant ICI traffic)",
+                            hint="drop one reduction; if the first was "
+                                 "a pmean keep ONLY it (see "
+                                 "ddp.sync_gradients' vma note)"))
+
+            # ---- CL203: loop-invariant collective in a scan body ----
+            if view.scan_num_consts is not None and all(
+                    isinstance(v, E._Literal) or v in inv
+                    for v in eqn.invars):
+                findings.append(make_finding(
+                    "CL203", loc,
+                    f"{prim} inside a scan body has loop-invariant "
+                    "operands — every iteration pays the collective "
+                    "for bytes that never change",
+                    hint="hoist the collective above the lax.scan and "
+                         "close over its result"))
+
+            # ---- CL204: fp16 summing collective ----
+            if prim in _SUMMING:
+                in_dt = E.dtype_name(eqn.invars[0])
+                if in_dt == "float16":
+                    findings.append(make_finding(
+                        "CL204", loc,
+                        f"{prim} sums float16 operands — under loss "
+                        "scaling the summands approach fp16's 65504 "
+                        "max and the overflow happens inside the "
+                        "collective, invisible to the finite-check",
+                        hint="unscale or upcast to float32/bfloat16 "
+                             "before the collective (bf16 carries "
+                             "fp32's exponent range)"))
+
+            # ---- CL205: dead collective ----
+            if eqn.outvars and not any(v in used for v in eqn.outvars):
+                findings.append(make_finding(
+                    "CL205", loc,
+                    f"{prim} result is never used (not a consumer, not "
+                    "a program output) — the source still builds and "
+                    "traces a reduction it throws away",
+                    hint="delete the call (XLA would DCE it, but the "
+                         "dead code misleads readers and slows "
+                         "tracing)"))
+    return findings

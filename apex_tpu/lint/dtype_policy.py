@@ -1,0 +1,203 @@
+"""Dtype-policy lint — the static form of Apex's AMP cast lists.
+
+The reference enforces mixed precision dynamically (op wrappers driven
+by allow/deny lists, apex/amp/lists/); under JAX the traced program
+makes the same properties *checkable before execution*:
+
+  DP101  a `dot_general`/conv executing in fp32 inside a region whose
+         policy is low-precision — the silent upcast that costs 8x MXU
+         throughput and the exact inverse of the cast-list contract.
+  DP102  a lossy convert round trip (f32 -> bf16 -> f32 with nothing
+         in between) on a tensor big enough to matter: mantissa
+         silently discarded, the downcast buys nothing.  The upcast
+         must be the downcast's ONLY consumer — a bf16 copy that also
+         feeds a GEMM is the normal mixed-precision shape.  Small
+         per-channel vectors are exempt — an active amp policy
+         downcasts norm scale/bias with the whole tree and the norm op
+         re-promotes them internally (the FP32_CLASS_OPS contract),
+         which is by-design, not a hazard.
+  DP103  low-precision ACCUMULATION in a large reduction: a
+         `reduce_sum`-class op summing >= threshold elements with a
+         bf16/fp16 accumulator.  jnp.sum ALWAYS upcasts to f32
+         internally (even with dtype=jnp.bfloat16 — the jaxpr is
+         convert->f32 reduce->downcast), so a low-precision reduce_sum
+         in the jaxpr can only come from a raw lax-level reduction.
+         dot_generals are NOT checked here: jnp sets
+         preferred_element_type to the input dtype by default, so the
+         param carries no user intent — and the TPU MXU accumulates
+         bf16 products in f32 regardless.
+  DP104  master-weight update math not in fp32: a large f32 program
+         output produced DIRECTLY by an upcast from a low-precision
+         value of the same shape — the whole update was computed in
+         low precision and the f32 master buffer only stores the
+         rounded result (the Apex master-weights guarantee, statically).
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from apex_tpu.lint import engine as E
+from apex_tpu.lint.findings import Finding, make_finding
+
+# GEMM-class primitives (the conv covers the ResNet path)
+_GEMM_PRIMS = ("dot_general", "conv_general_dilated")
+
+# reductions whose accumulator dtype matters (max/min need no
+# accumulation precision; cumsum's output size makes the
+# reduction-length heuristic meaningless)
+_ACCUM_REDUCTIONS = ("reduce_sum", "reduce_prod")
+
+
+def _gemm_in_dtypes(eqn):
+    return [E.dtype_name(v) for v in eqn.invars[:2]]
+
+
+def _use_counts(jaxpr) -> dict:
+    """var -> number of consuming sites (eqn inputs + jaxpr outputs)."""
+    out: dict = {}
+    for eqn in jaxpr.eqns:
+        for v in eqn.invars:
+            if not isinstance(v, E._Literal):
+                out[v] = out.get(v, 0) + 1
+    for v in jaxpr.outvars:
+        if not isinstance(v, E._Literal):
+            out[v] = out.get(v, 0) + 1
+    return out
+
+
+def _infer_low_region(views) -> bool:
+    """With no declared compute dtype: the program is a low-precision
+    region when at least half its GEMMs run low-precision operands."""
+    low = total = 0
+    for view in views:
+        for eqn in view.jaxpr.eqns:
+            if eqn.primitive.name in _GEMM_PRIMS:
+                dts = _gemm_in_dtypes(eqn)
+                if not any(E.is_float(d) for d in dts):
+                    continue  # integer/bool dots are not policy-bound
+                total += 1
+                if any(E.is_low_precision(d) for d in dts):
+                    low += 1
+    return total > 0 and low * 2 >= total
+
+
+def run(views, *, program: str, config: E.LintConfig) -> List[Finding]:
+    findings: List[Finding] = []
+    declared = config.compute_dtype
+    low_region = (declared in E.LOW_PRECISION if declared is not None
+                  else _infer_low_region(views))
+
+    for view in views:
+        prods = E.producers(view.jaxpr)
+        use_counts = _use_counts(view.jaxpr)
+        convert_eqns = [e for e in view.jaxpr.eqns
+                        if e.primitive.name == "convert_element_type"]
+        counts: dict = {}
+        for eqn in view.jaxpr.eqns:
+            prim = eqn.primitive.name
+            idx = counts.get(prim, 0)
+            counts[prim] = idx + 1
+            loc = view.eqn_location(program, eqn, idx)
+
+            # ---- DP101: fp32 GEMM inside a low-precision region ----
+            if low_region and prim in _GEMM_PRIMS:
+                dts = _gemm_in_dtypes(eqn)
+                if any(d == "float32" for d in dts) \
+                        and not any(E.is_low_precision(d) for d in dts):
+                    findings.append(make_finding(
+                        "DP101", loc,
+                        f"{prim} runs float32 operands inside a "
+                        f"{declared or 'low-precision'} policy region "
+                        "(8x MXU throughput left on the table)",
+                        hint="cast the operands to the compute dtype at "
+                             "the call site (policy.cast_to_compute), or "
+                             "allowlist if this GEMM is deliberately "
+                             "fp32-class"))
+
+            # ---- DP102: lossy convert round trip ----
+            if prim == "convert_element_type":
+                src = eqn.invars[0]
+                mid_eqn = (None if isinstance(src, E._Literal)
+                           else prods.get(src))
+                if (mid_eqn is not None
+                        and mid_eqn.primitive.name
+                        == "convert_element_type"):
+                    d0 = E.dtype_name(mid_eqn.invars[0])
+                    d1 = E.dtype_name(src)
+                    d2 = E.dtype_name(eqn.outvars[0])
+                    # the upcast must be the downcast's ONLY consumer:
+                    # a bf16 copy that ALSO feeds a GEMM is the normal
+                    # mixed-precision shape, not a wasted round trip
+                    if (d0 == d2 and d0 == "float32"
+                            and E.is_low_precision(d1)
+                            and use_counts.get(src, 0) == 1
+                            and E.num_elements(eqn.outvars[0])
+                            >= config.min_roundtrip_elems):
+                        findings.append(make_finding(
+                            "DP102", loc,
+                            f"value round-trips {d0} -> {d1} -> {d2} "
+                            "with no compute in between — the mantissa "
+                            "is discarded for nothing",
+                            hint="drop both casts, or keep the value in "
+                                 f"{d1} if the downcast was the intent"))
+
+            # ---- DP103a: low-precision large reduce_sum ----
+            if prim in _ACCUM_REDUCTIONS:
+                in_dt = E.dtype_name(eqn.invars[0])
+                out_dt = E.dtype_name(eqn.outvars[0])
+                n_in = E.num_elements(eqn.invars[0])
+                n_out = max(1, E.num_elements(eqn.outvars[0]))
+                reduced = n_in // n_out
+                if (E.is_low_precision(in_dt)
+                        and E.is_low_precision(out_dt)
+                        and reduced >= config.reduction_threshold):
+                    findings.append(make_finding(
+                        "DP103", loc,
+                        f"{prim} accumulates {reduced} {in_dt} elements "
+                        f"in {out_dt} — error grows with the reduction "
+                        "size",
+                        hint="accumulate in float32 (jnp.sum(x, "
+                             "dtype=jnp.float32)) and downcast the "
+                             "result if needed"))
+
+
+        # ---- DP104: master update math not in fp32 ----
+        # only program-boundary outputs are master buffers (the
+        # outermost jaxpr and its jit/shard_map bodies — NOT scan
+        # carries or remat bodies, whose outputs legitimately change
+        # dtype); a large f32 output whose producing eqn is an upcast
+        # from a low-precision SAME-SHAPE value means the whole update
+        # was computed low-precision and merely stored f32
+        boundary = view.scan_num_consts is None and all(
+            part in ("", "pjit", "shard_map", "closed_call", "jit")
+            for part in view.path.split("/"))
+        if boundary:
+            seen = set()
+            for ov in view.jaxpr.outvars:
+                if isinstance(ov, E._Literal) or ov in seen:
+                    continue
+                seen.add(ov)
+                if E.dtype_name(ov) != "float32":
+                    continue
+                if E.num_elements(ov) < config.large_output_elems:
+                    continue
+                p = prods.get(ov)
+                if p is None or p.primitive.name != "convert_element_type":
+                    continue
+                src_dt = E.dtype_name(p.invars[0])
+                if (E.is_low_precision(src_dt)
+                        and E.num_elements(p.invars[0])
+                        == E.num_elements(ov)):
+                    idx = convert_eqns.index(p)
+                    findings.append(make_finding(
+                        "DP104", view.eqn_location(program, p, idx),
+                        f"a {E.num_elements(ov)}-element float32 state "
+                        f"output is a bare upcast of a {src_dt} value — "
+                        "the master-weight update math ran in "
+                        f"{src_dt}, the f32 buffer only stores the "
+                        "rounded result",
+                        hint="compute the update in float32 (cast the "
+                             "grads up BEFORE the optimizer math), the "
+                             "Apex master-weights contract"))
+    return findings

@@ -78,6 +78,13 @@ class CompileReport:
     # HBM budget classification (params / optimizer_state / inputs /
     # activations_temps / outputs / generated_code)
     budget: dict
+    # static-analysis attachment (ISSUE 6): analyze_step(..., lint=True)
+    # runs apex_tpu.lint's program passes over the SAME step/args and
+    # stores {"ok": bool, "findings": [Finding.to_dict(), ...]} here —
+    # so the flight-recorder crash dump (which carries this report)
+    # dies with the lint verdict alongside the HBM budget.  None when
+    # linting was not requested.
+    lint: Optional[dict] = None
 
     def to_dict(self) -> dict:
         """Flat JSON-able dict (what the flight recorder attaches)."""
@@ -141,7 +148,8 @@ def analyze_step(step_fn, args: Sequence[Any], *,
                  arg_names: Optional[Sequence[str]] = None,
                  analytic_flops: Optional[float] = None,
                  flops_tol: float = 0.10,
-                 donation_tol: float = DONATION_TOL) -> CompileReport:
+                 donation_tol: float = DONATION_TOL,
+                 lint: bool = False) -> CompileReport:
     """Lower + compile `step_fn(*args)` WITHOUT executing and return
     the `CompileReport`.
 
@@ -157,6 +165,11 @@ def analyze_step(step_fn, args: Sequence[Any], *,
     (None reads `step_fn.arg_names`, falling back to `arg{i}`).
     analytic_flops: the `monitor.flops` count for one step — the
     cross-check that validates every published MFU number.
+    lint: also run `apex_tpu.lint`'s static program passes
+    (dtype-policy, collectives, donation incl. the DN302 cross-check
+    against THIS report's donation_ok) over the same step/args and
+    attach the result as `report.lint` — so a crash dump carrying the
+    report carries the lint verdict too.
     """
     lower = getattr(step_fn, "lower", None)
     if lower is None:
@@ -215,7 +228,7 @@ def analyze_step(step_fn, args: Sequence[Any], *,
     budget["outputs"] = op_b
     budget["generated_code"] = code_b
 
-    return CompileReport(
+    report = CompileReport(
         backend=backend, device_kind=device_kind,
         argument_bytes=None if arg_b is None else int(arg_b),
         output_bytes=None if op_b is None else int(op_b),
@@ -235,6 +248,22 @@ def analyze_step(step_fn, args: Sequence[Any], *,
         flops_ok=flops_ok,
         budget=budget,
     )
+    if lint:
+        # advisory, never fatal (the observatory's degradation
+        # contract): a lint-side crash must not void the audit that
+        # already succeeded — it becomes {"ok": None, "error": ...}
+        try:
+            from apex_tpu import lint as lint_lib
+            findings = lint_lib.lint_step(
+                step_fn, args, program="analyze_step",
+                arg_names=names, donate_argnums=donated,
+                compile_report=report)
+            report.lint = {"ok": not findings,
+                           "findings": [f.to_dict() for f in findings]}
+        except Exception as e:
+            report.lint = {"ok": None, "findings": [],
+                           "error": repr(e)[:200]}
+    return report
 
 
 def _human_bytes(b) -> str:
@@ -290,4 +319,15 @@ def render_budget_table(report) -> str:
         lines.append(
             f"flops: xla agrees with analytic accounting to "
             f"{100 * r['flops_divergence']:.1f}%")
+    lint = r.get("lint")
+    if lint is not None:
+        if lint.get("ok"):
+            lines.append("lint: clean (static program passes)")
+        else:
+            rules = sorted({f.get("rule", "?")
+                            for f in lint.get("findings") or []})
+            lines.append(
+                f"** LINT: {len(lint.get('findings') or [])} "
+                f"finding(s) [{', '.join(rules)}] — run "
+                "scripts/lint_step.py for the full report")
     return "\n".join(lines)

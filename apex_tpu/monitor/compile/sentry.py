@@ -79,6 +79,16 @@ class RecompileSentry:
         cache_owner = getattr(step_fn, "jitted", step_fn)
         self._cache_size = getattr(cache_owner, "_cache_size", None)
 
+    @property
+    def wrapped(self):
+        """The step underneath — for tools that need to TRACE the step
+        without running the sentry's host-side bookkeeping on tracer
+        arguments (apex_tpu.lint traces `wrapped`, else the trace
+        would bump `calls` and pre-register the argument signature,
+        hiding the genuine first compile from the signature-proxy
+        path)."""
+        return self._fn
+
     def _poll(self) -> Optional[int]:
         if self._cache_size is None:
             return None

@@ -22,8 +22,14 @@ from typing import Optional
 
 import jax
 
-# the fields worth carrying; anything else the runtime reports rides
-# along untouched in device_memory_stats()' full dict
+# the known watermark fields (the TPU runtime's canonical names);
+# hbm_watermarks() always emits these three — None when the runtime
+# withholds one or reports a value that does not coerce to an int —
+# and passes any EXTRA integer-valued stats keys through under the
+# same hbm_ prefix (a future allocator reporting more must not lose
+# fields to this tuple being stale; the JSONL schema treats every
+# hbm_* as an optional null-legal scalar).  Extend this tuple when a
+# real runtime's names are verified.
 WATERMARK_FIELDS = ("bytes_in_use", "peak_bytes_in_use", "bytes_limit")
 
 _OOM_MARKERS = ("RESOURCE_EXHAUSTED", "RESOURCE EXHAUSTED",
@@ -49,13 +55,36 @@ def device_memory_stats(device=None) -> Optional[dict]:
     return stats
 
 
-def hbm_watermarks(device=None) -> dict:
-    """The per-record watermark fields, always present, None when the
-    backend withholds them: {"hbm_bytes_in_use": int|None,
-    "hbm_peak_bytes_in_use": int|None, "hbm_bytes_limit": int|None}."""
-    stats = device_memory_stats(device) or {}
-    return {f"hbm_{k}": (int(stats[k]) if k in stats else None)
-            for k in WATERMARK_FIELDS}
+def _as_int(value) -> Optional[int]:
+    """Coerce one allocator stat to an int, or None — a runtime that
+    reports a float, a numpy scalar, or garbage for a field must cost
+    that FIELD, never the record (bools are not byte counts)."""
+    if isinstance(value, bool):
+        return None
+    try:
+        return int(value)
+    except (TypeError, ValueError):
+        return None
+
+
+def hbm_watermarks(device=None, stats: Optional[dict] = None) -> dict:
+    """The per-record watermark fields: always the three
+    WATERMARK_FIELDS (`hbm_bytes_in_use` / `hbm_peak_bytes_in_use` /
+    `hbm_bytes_limit`, None when the backend withholds or mangles
+    one), plus an `hbm_<key>` passthrough for every EXTRA
+    integer-valued key the runtime reports — unknown allocator fields
+    ride along instead of vanishing.  `stats` overrides the device
+    read (tests feed fake dicts)."""
+    if stats is None:
+        stats = device_memory_stats(device) or {}
+    out = {f"hbm_{k}": _as_int(stats.get(k)) for k in WATERMARK_FIELDS}
+    for k, v in stats.items():
+        if k in WATERMARK_FIELDS or not isinstance(k, str):
+            continue
+        iv = _as_int(v)
+        if iv is not None:
+            out[f"hbm_{k}"] = iv
+    return out
 
 
 def all_device_memory_stats() -> Optional[dict]:

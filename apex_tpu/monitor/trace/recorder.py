@@ -34,7 +34,11 @@ v2 (ISSUE 5) added the compile & HBM observatory plane: the last
 the OOM-forensics contract — `guard()` classifies a
 RESOURCE_EXHAUSTED death (`compile.is_oom`) and dumps with `oom:
 true` plus a fresh per-device memory snapshot, so an OOM dies with a
-budget table instead of a bare stack trace.
+budget table instead of a bare stack trace.  A report produced by
+`analyze_step(..., lint=True)` (ISSUE 6) additionally carries the
+static linter's verdict in its `lint` field — the crash dump then
+tells the lint story too, with no schema change here (the field rides
+inside compile_report).
 
 Non-finite floats (an overflow step's absmax is ±inf by construction)
 are serialized through `sinks.sanitize_json_floats` — the report is

@@ -518,6 +518,8 @@ def make_train_step(loss_fn: Callable, optimizer, mesh, *,
         try:
             jitted.donate_argnums = donate_args
             jitted.arg_names = tuple(names)
+            jitted.mesh_axis_names = tuple(
+                str(a) for a in mesh.axis_names)
         except AttributeError:  # pragma: no cover
             pass
         return jitted
@@ -549,4 +551,8 @@ def make_train_step(loss_fn: Callable, optimizer, mesh, *,
     step.jitted = jitted
     step.donate_argnums = donate_args
     step.arg_names = tuple(names)
+    # the static linter's collective pass (apex_tpu.lint CL201) checks
+    # every traced psum/all_gather axis against the mesh that will run
+    # the program — the builder is the one place both are known
+    step.mesh_axis_names = tuple(str(a) for a in mesh.axis_names)
     return step

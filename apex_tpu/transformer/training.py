@@ -144,4 +144,7 @@ def make_tp_dp_train_step(model, optimizer, mesh, *,
     step._cache_size = _cache_size
     step.donate_argnums = (0,) if donate else ()
     step.arg_names = ("opt_state", "tokens", "labels")
+    # mesh axes for the static linter's collective-axis check
+    # (apex_tpu.lint CL201) — see parallel/ddp.py
+    step.mesh_axis_names = tuple(str(a) for a in mesh.axis_names)
     return step

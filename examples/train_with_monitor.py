@@ -151,7 +151,9 @@ def main():
     if flight:
         # AOT compile audit of the exact step about to run (compiles
         # without executing): the crash dump then carries the HBM
-        # budget table — the OOM-forensics payload
+        # budget table — the OOM-forensics payload.  lint=True also
+        # attaches the static program passes' verdict (apex_tpu.lint),
+        # so a crash dies with its lint findings alongside the budget
         try:
             _, audit_batch = make_batch(jax.random.PRNGKey(0))
             audit_args = (opt_state_box[0], scaler_box[0], audit_batch,
@@ -161,7 +163,8 @@ def main():
                               (dp, 1))))
             recorder.attach_compile_report(monitor.analyze_step(
                 sentry, audit_args,
-                analytic_flops=monitor.gpt_step_flops(cfg, args.batch)))
+                analytic_flops=monitor.gpt_step_flops(cfg, args.batch),
+                lint=True))
         except Exception as e:  # audit is advisory, never fatal
             print(f"compile audit unavailable: {e!r}")
 

@@ -63,7 +63,9 @@ def main():
 
     for lanes in (128, 256, 512):
         for rows in (256, 512, 1024):
-            step = jax.jit(functools.partial(adam_lanes, lanes=lanes,
+            # deliberate jit-per-candidate: each (lanes, rows) point is
+            # a different kernel; the probe pays one compile per point
+            step = jax.jit(functools.partial(adam_lanes, lanes=lanes,  # lint: disable=HS405
                                              rows=rows),
                            donate_argnums=(0, 1, 2))
             try:

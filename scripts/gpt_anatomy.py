@@ -33,6 +33,9 @@ Usage:
                                                            # (nonzero exit on drift)
   python scripts/gpt_anatomy.py mem [targets...]           # AOT HBM budget tables
                                                            # (compile only, no execute)
+  python scripts/gpt_anatomy.py lint [targets...]          # static lint of the bench
+                                                           # steps (trace only; nonzero
+                                                           # exit on new findings)
 
 `tune` drives apex_tpu.tune.search over each target's flash shape (and
 the flat-Adam block at the 1B point), writes the winners to the
@@ -435,16 +438,14 @@ def tune_mode(targets, check=False):
 
 # --------------------------- AOT memory anatomy ---------------------------
 
-def mem_mode(targets):
-    """Per-target HBM budget via the compile observatory (ISSUE 5):
-    build the EXACT bench train step for each config, AOT lower+compile
-    it WITHOUT executing, and print the budget table (params /
-    optimizer state / activations+temps), the donation check, and the
-    flops cross-check against monitor.flops' analytic accounting — the
-    table an operator reads before picking a batch size.  On a CPU
-    backend the big configs would take minutes of XLA compile for no
-    memory truth, so a tiny smoke config substitutes (the table
-    structure and checks still exercise end to end)."""
+def _build_bench_step(t, on_tpu, mode="mem"):
+    """Build one CONFIGS target's EXACT bench train step without
+    compiling or executing it.  Returns (label, step, abstract args,
+    analytic flops) — shared by `mem` (AOT budget) and `lint` (static
+    analysis).  On a CPU backend the big configs would take minutes of
+    XLA compile (mem) for no extra truth, so the smoke size
+    substitutes while KEEPING the model family / optimizer / loss
+    shape, so every target's build path stays exercised."""
     import jax.numpy as jnp
 
     from apex_tpu import monitor
@@ -458,70 +459,80 @@ def mem_mode(targets):
         make_tp_dp_train_step,
     )
 
+    nm, h, L, H, b, s, v, c = CONFIGS[t]
+    is_bert = not c  # the one bidirectional bench config
+    if on_tpu:
+        batch = b
+    else:
+        print(f"--- {mode} {nm}: CPU backend, shrinking to the smoke "
+              "config (structure only; run on TPU for real shapes)",
+              flush=True)
+        h, L, H, v = 64, 2, 4, 512
+        batch, s = 2, 64
+    M.destroy_model_parallel()
+    mesh = M.initialize_model_parallel(devices=jax.devices()[:1])
+    loss_fn = None
+    if is_bert:
+        # mirror bench._bert_seq_per_sec: BERT-Large MLM+NSP step
+        # with FusedLAMB — the program must be the EXACT one the
+        # bench times, not a causal GPT stand-in
+        cfg = BertConfig(vocab_size=v, seq_len=s, hidden=h,
+                         num_layers=L, num_heads=H,
+                         dtype=jnp.bfloat16 if on_tpu
+                         else jnp.float32,
+                         use_flash_attention=on_tpu)
+        model = Bert(cfg)
+        loss_mask = jnp.zeros((batch, s), bool)
+        nsp = jnp.zeros((batch,), jnp.int32)
+
+        def loss_fn(p, tk, lb):
+            return model.loss(p, tk, lb, loss_mask, nsp_labels=nsp)
+
+        opt = FusedLAMB(lr=1e-4, weight_decay=0.01,
+                        use_pallas=on_tpu,
+                        master_dtype=jnp.bfloat16 if on_tpu
+                        else jnp.float32)
+        analytic = monitor.bert_step_flops(cfg, batch, seq=s)
+    else:
+        cfg = (GPTConfig(vocab_size=v, seq_len=s, hidden=h,
+                         num_layers=L, num_heads=H, dropout=0.0,
+                         dtype=jnp.bfloat16,
+                         logits_dtype=jnp.bfloat16, remat=False,
+                         use_flash_attention=True) if on_tpu else
+               GPTConfig(vocab_size=v, seq_len=s, hidden=h,
+                         num_layers=L, num_heads=H, dropout=0.0))
+        model = GPT(cfg)
+        opt = FusedAdam(lr=1e-4, use_pallas=on_tpu,
+                        master_dtype=jnp.bfloat16 if on_tpu
+                        else jnp.float32)
+        analytic = monitor.gpt_step_flops(cfg, batch, seq=s)
+    params = model.init(jax.random.PRNGKey(0))
+    opt_state = init_sharded_optimizer(opt, model, params, mesh)
+    step = make_tp_dp_train_step(model, opt, mesh, loss_fn=loss_fn,
+                                 donate=True)
+    del params
+    tokens = jax.ShapeDtypeStruct((batch, s), jnp.int32)
+    labels = jax.ShapeDtypeStruct((batch, s), jnp.int32)
+    label = f"{nm}: h{h} L{L} H{H} b{batch} s{s}"
+    return label, step, (opt_state, tokens, labels), analytic
+
+
+def mem_mode(targets):
+    """Per-target HBM budget via the compile observatory (ISSUE 5):
+    build the EXACT bench train step for each config, AOT lower+compile
+    it WITHOUT executing, and print the budget table (params /
+    optimizer state / activations+temps), the donation check, and the
+    flops cross-check against monitor.flops' analytic accounting — the
+    table an operator reads before picking a batch size."""
+    from apex_tpu import monitor
+    from apex_tpu.parallel import mesh as M
+
     on_tpu = jax.default_backend() not in ("cpu",)
     rc = 0
     for t in targets:
-        nm, h, L, H, b, s, v, c = CONFIGS[t]
-        is_bert = not c  # the one bidirectional bench config
-        if on_tpu:
-            batch = b
-        else:
-            # CPU: the big configs cost minutes of XLA compile for no
-            # memory truth — shrink to smoke size but KEEP the model
-            # family so every target's build path stays exercised
-            print(f"--- mem {nm}: CPU backend, shrinking to the smoke "
-                  "config (structure only; run on TPU for real bytes)",
-                  flush=True)
-            h, L, H, v = 64, 2, 4, 512
-            batch, s = 2, 64
-        M.destroy_model_parallel()
-        mesh = M.initialize_model_parallel(devices=jax.devices()[:1])
-        loss_fn = None
-        if is_bert:
-            # mirror bench._bert_seq_per_sec: BERT-Large MLM+NSP step
-            # with FusedLAMB — the budget must be of the EXACT program
-            # the bench times, not a causal GPT stand-in
-            cfg = BertConfig(vocab_size=v, seq_len=s, hidden=h,
-                             num_layers=L, num_heads=H,
-                             dtype=jnp.bfloat16 if on_tpu
-                             else jnp.float32,
-                             use_flash_attention=on_tpu)
-            model = Bert(cfg)
-            loss_mask = jnp.zeros((batch, s), bool)
-            nsp = jnp.zeros((batch,), jnp.int32)
-
-            def loss_fn(p, tk, lb):
-                return model.loss(p, tk, lb, loss_mask, nsp_labels=nsp)
-
-            opt = FusedLAMB(lr=1e-4, weight_decay=0.01,
-                            use_pallas=on_tpu,
-                            master_dtype=jnp.bfloat16 if on_tpu
-                            else jnp.float32)
-            analytic = monitor.bert_step_flops(cfg, batch, seq=s)
-        else:
-            cfg = (GPTConfig(vocab_size=v, seq_len=s, hidden=h,
-                             num_layers=L, num_heads=H, dropout=0.0,
-                             dtype=jnp.bfloat16,
-                             logits_dtype=jnp.bfloat16, remat=False,
-                             use_flash_attention=True) if on_tpu else
-                   GPTConfig(vocab_size=v, seq_len=s, hidden=h,
-                             num_layers=L, num_heads=H, dropout=0.0))
-            model = GPT(cfg)
-            opt = FusedAdam(lr=1e-4, use_pallas=on_tpu,
-                            master_dtype=jnp.bfloat16 if on_tpu
-                            else jnp.float32)
-            analytic = monitor.gpt_step_flops(cfg, batch, seq=s)
-        params = model.init(jax.random.PRNGKey(0))
-        opt_state = init_sharded_optimizer(opt, model, params, mesh)
-        step = make_tp_dp_train_step(model, opt, mesh, loss_fn=loss_fn,
-                                     donate=True)
-        del params
-        tokens = jax.ShapeDtypeStruct((batch, s), jnp.int32)
-        labels = jax.ShapeDtypeStruct((batch, s), jnp.int32)
-        print(f"\n--- mem {nm}: h{h} L{L} H{H} b{batch} s{s} "
-              f"(AOT, no execution)", flush=True)
-        rep = monitor.analyze_step(step, (opt_state, tokens, labels),
-                                   analytic_flops=analytic)
+        label, step, args, analytic = _build_bench_step(t, on_tpu)
+        print(f"\n--- mem {label} (AOT, no execution)", flush=True)
+        rep = monitor.analyze_step(step, args, analytic_flops=analytic)
         print(monitor.render_budget_table(rep), flush=True)
         if on_tpu and (rep.donation_ok is False or rep.flops_ok is False):
             # a flagged budget is a failed gate, CI-style — but only
@@ -535,6 +546,39 @@ def mem_mode(targets):
               f"{live.get('bytes_in_use', 0) / 2**30:.2f} GiB in use, "
               f"{live.get('peak_bytes_in_use', 0) / 2**30:.2f} GiB peak",
               flush=True)
+    return rc
+
+
+def lint_mode(targets):
+    """Static lint of each target's EXACT bench train step (ISSUE 6):
+    trace — never compile, never execute — and run apex_tpu.lint's
+    dtype-policy / collective / donation passes.  Nonzero exit on any
+    finding outside the committed allowlist
+    (scripts/lint_allowlist.txt); `scripts/lint_step.py` is the richer
+    CLI (adds the repo AST pass + --selftest)."""
+    import os as _os
+
+    from apex_tpu import lint
+    from apex_tpu.parallel import mesh as M
+
+    allowlist_path = _os.path.join(
+        _os.path.dirname(_os.path.abspath(__file__)),
+        "lint_allowlist.txt")
+    allowlist = (lint.load_allowlist(allowlist_path)
+                 if _os.path.exists(allowlist_path) else [])
+    on_tpu = jax.default_backend() not in ("cpu",)
+    rc = 0
+    for t in targets:
+        label, step, args, _ = _build_bench_step(t, on_tpu, mode="lint")
+        print(f"\n--- lint {label} (trace only, no compile)",
+              flush=True)
+        findings = lint.lint_step(step, args, program=t)
+        new, allowed = lint.apply_allowlist(findings, allowlist)
+        rep = lint.LintReport(target=t, new=new, allowlisted=allowed)
+        print(lint.render_findings(rep), flush=True)
+        if not rep.ok:
+            rc = 1
+        M.destroy_model_parallel()
     return rc
 
 
@@ -576,6 +620,13 @@ if __name__ == "__main__":
             sys.exit(f"unknown mem target(s) {bad}; "
                      f"choices: {sorted(CONFIGS)}")
         sys.exit(mem_mode(targets))
+    elif which == "lint":
+        targets = sys.argv[2:] or ["350m", "bert"]
+        bad = [t for t in targets if t not in CONFIGS]
+        if bad:
+            sys.exit(f"unknown lint target(s) {bad}; "
+                     f"choices: {sorted(CONFIGS)}")
+        sys.exit(lint_mode(targets))
     elif which == "blocks":
         flash_block_sweep(causal=False)   # BERT shape
         flash_block_sweep(batch=7, heads=32, seq=512, causal=True)  # 1.3B
@@ -590,4 +641,5 @@ if __name__ == "__main__":
     else:
         sys.exit(f"unknown mode {which!r}; expected one of "
                  f"{sorted(CONFIGS)} | both | roofline [target...] | "
-                 "blocks | tune [--check] [target...] | mem [target...]")
+                 "blocks | tune [--check] [target...] | mem [target...]"
+                 " | lint [target...]")

@@ -278,3 +278,38 @@ def test_device_peak_flops_table_and_fallback():
     assert monitor.device_peak_flops("TPU v4", override=1e12) == 1e12
     # mfu resolves the same table when peak_flops is omitted
     assert monitor.mfu(monitor.V5E_BF16_PEAK, 1.0) == pytest.approx(1.0)
+
+
+# ------------------------------ watermarks ------------------------------
+
+def test_hbm_watermarks_tolerates_fake_stats_shapes():
+    """PR 5 NOTE hardening: the TPU runtime's memory_stats() key set is
+    an assumption — missing keys become None, extra integer keys pass
+    through under the hbm_ prefix, and non-coercible values cost the
+    FIELD, never the record."""
+    from apex_tpu.monitor.compile import watermarks as wm
+
+    # the assumed canonical shape
+    full = {"bytes_in_use": 7, "peak_bytes_in_use": 9, "bytes_limit": 11}
+    assert wm.hbm_watermarks(stats=full) == {
+        "hbm_bytes_in_use": 7, "hbm_peak_bytes_in_use": 9,
+        "hbm_bytes_limit": 11}
+
+    # missing + extra + garbage, all at once
+    weird = {"bytes_in_use": 3.0,            # float: coerces
+             "bytes_limit": "16GiB",         # garbage: None
+             "bytes_reserved": 42,           # unknown int: passthrough
+             "allocator": "bfc",             # unknown str: dropped
+             "oom": True,                    # bool is not a byte count
+             7: 99}                          # non-str key: dropped
+    got = wm.hbm_watermarks(stats=weird)
+    assert got == {"hbm_bytes_in_use": 3,
+                   "hbm_peak_bytes_in_use": None,
+                   "hbm_bytes_limit": None,
+                   "hbm_bytes_reserved": 42}
+
+    # the three canonical fields are ALWAYS present (empty stats too),
+    # and every emitted value is schema-legal (int or None)
+    empty = wm.hbm_watermarks(stats={})
+    assert set(empty) == {f"hbm_{k}" for k in wm.WATERMARK_FIELDS}
+    assert all(v is None for v in empty.values())

@@ -181,7 +181,9 @@ def test_make_train_step_zero2_matches_fused_adam():
                                    weight_decay=0.01, use_pallas=False,
                                    n_buckets=nb)
         sspec = opt.state_partition_specs()
-        state = jax.jit(shard_map(opt.init, mesh=mesh, in_specs=(P(),),
+        # fresh optimizer per bucket config: the per-iteration init
+        # jit is inherent to the sweep, not a retrace leak
+        state = jax.jit(shard_map(opt.init, mesh=mesh, in_specs=(P(),),  # lint: disable=HS405
                                   out_specs=sspec,
                                   check_vma=False))(params0)
         step = ddp.make_train_step(loss_fn, opt, mesh,
@@ -190,7 +192,7 @@ def test_make_train_step_zero2_matches_fused_adam():
         for _ in range(5):
             state, _, loss = step(state, None, (X, Y))
             losses.append(float(loss))
-        gather = jax.jit(shard_map(
+        gather = jax.jit(shard_map(  # lint: disable=HS405
             lambda s: opt.full_params(s), mesh=mesh, in_specs=(sspec,),
             out_specs=P(), check_vma=False))
         p_z = gather(state)
