@@ -22,6 +22,12 @@ Three layers:
                budget table, donation + flops cross-checks), the
                `RecompileSentry`, and device-memory watermarks + OOM
                forensics (`monitor.compile` subpackage)
+  * comms    — the collective & overlap observatory (ISSUE 7):
+               optimized-HLO collective inventory
+               (`comms_report` -> `CommsReport`), async start/done
+               overlap classification, and the per-device-kind ICI
+               roofline (`monitor.comms` subpackage; CI-gated by
+               `scripts/comms_probe.py`)
 
 See docs/observability.md for the JSONL schema and recipes, and
 examples/train_with_monitor.py for the end-to-end loop.
@@ -44,6 +50,14 @@ from apex_tpu.monitor.compile import (  # noqa: F401
     analyze_step,
     device_memory_stats,
     render_budget_table,
+)
+from apex_tpu.monitor import comms  # noqa: F401
+from apex_tpu.monitor.comms import (  # noqa: F401
+    DEVICE_ICI_BANDWIDTH,
+    CommsReport,
+    comms_report,
+    device_link_bandwidth,
+    render_comms_table,
 )
 from apex_tpu.monitor.logger import (  # noqa: F401
     SCHEMA,

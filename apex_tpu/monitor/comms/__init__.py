@@ -1,0 +1,50 @@
+"""apex_tpu.monitor.comms — the collective & overlap observatory
+(ISSUE 7).
+
+The communications half of the compile observatory: where
+`monitor.compile` audits what the compiled step HOLDS (HBM budget) and
+COMPUTES (flops), this audits what it says over the interconnect —
+the plane ZeRO-3 and the TP compute/collective overlap work (ROADMAP
+items 1-2) live or die by.  Three cooperating pieces:
+
+  * hlo      — optimized-HLO text parsing (instructions, replica
+               groups, async start/done pairing, dot-FLOP accounting);
+               no jax import, testable on committed fixtures.
+  * roofline — `DEVICE_ICI_BANDWIDTH` (the sibling of
+               `flops.DEVICE_BF16_PEAKS`) + the ring-algorithm cost
+               formulas that price each collective analytically.
+  * report   — `comms_report(step, args) -> CommsReport`: the
+               inventory, the per-collective overlap classification
+               (dot flops scheduled between an async collective's
+               start and done), the comm-bound verdict, the
+               serialized-collective gate findings, and the runtime
+               cross-check against the rank-timing plane.
+
+Wiring: `monitor.analyze_step(..., comms=True)` attaches the report to
+the `CompileReport` (and thereby the flight-recorder crash dump);
+`scripts/comms_probe.py` is the CI gate; `scripts/gpt_anatomy.py comms`
+prints the tables for the bench configs.  See docs/observability.md
+"Reading the comms table".
+"""
+
+from apex_tpu.monitor.comms import hlo  # noqa: F401
+from apex_tpu.monitor.comms.report import (  # noqa: F401
+    COMMS_SCHEMA_VERSION,
+    OVERLAP_BYTES_FLOOR,
+    Collective,
+    CommsReport,
+    apply_allowlist,
+    comms_report,
+    crosscheck_rank_timing,
+    inventory_from_hlo,
+    parse_allowlist,
+    render_comms_table,
+    serialized_collectives,
+    validate_comms_report,
+)
+from apex_tpu.monitor.comms.roofline import (  # noqa: F401
+    DEVICE_ICI_BANDWIDTH,
+    V5E_ICI_BYTES_PER_S,
+    collective_seconds,
+    device_link_bandwidth,
+)

@@ -1,0 +1,396 @@
+"""Optimized-HLO text parsing for the comms observatory.
+
+The collective inventory reads the POST-optimization HLO module
+(`step.lower(*args).compile().as_text()`) — the program XLA actually
+schedules — not stablehlo: collective combining, async conversion, and
+the instruction schedule only exist after optimization, and those are
+exactly what the overlap analysis is about.
+
+This module is a text parser, deliberately: `as_text()` is the one
+stable, backend-independent view of the optimized module that every
+jaxlib this repo supports exposes (the in-memory
+`hlo_modules()`/buffer-assignment APIs drift per version).  It parses
+only what the inventory needs —
+
+  * computations and their instruction lists, in printed order (for a
+    scheduled module the printed order of the entry computation IS the
+    schedule; for an unscheduled one it is a topological order, which
+    the analyzer reports as such via `async_supported=False`),
+  * per-instruction: name, opcode, result/operand shapes,
+    `replica_groups` (both the explicit `{{0,1},{2,3}}` and the iota
+    `[2,2]<=[4]` forms), `source_target_pairs`, `channel_id`,
+    `calls=`/`to_apply=` edges, and the `metadata={op_name=...}` hint,
+  * dot FLOPs per computation (2 * prod(output) * prod(contracted lhs
+    dims)), folded transitively through fusion/call edges so the
+    overlap window can price the compute scheduled between an async
+    collective's start and done.  While/conditional bodies count ONCE
+    (trip counts are runtime values) — documented undercount, fine for
+    a "did anything overlap at all" classification.
+
+Nothing here imports jax — the parser is testable on committed HLO
+text fixtures.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Dict, List, Optional, Sequence, Tuple
+
+# HLO primitive element type -> bytes.  token/opaque/tuple contribute 0.
+_ITEMSIZE = {
+    "pred": 1, "s2": 1, "u2": 1, "s4": 1, "u4": 1,
+    "s8": 1, "u8": 1, "f8e4m3fn": 1, "f8e4m3": 1, "f8e5m2": 1,
+    "f8e4m3b11fnuz": 1, "f8e5m2fnuz": 1, "f8e4m3fnuz": 1,
+    "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4,
+    "s64": 8, "u64": 8, "f64": 8, "c64": 8,
+    "c128": 16,
+}
+
+# the five collective families the inventory tracks (ISSUE 7)
+COLLECTIVE_KINDS = ("all-reduce", "all-gather", "reduce-scatter",
+                    "all-to-all", "collective-permute")
+
+
+def itemsize(dtype: str) -> int:
+    return _ITEMSIZE.get(dtype, 0)
+
+
+@dataclasses.dataclass
+class Shape:
+    dtype: str
+    dims: Tuple[int, ...]
+
+    @property
+    def elements(self) -> int:
+        n = 1
+        for d in self.dims:
+            n *= d
+        return n
+
+    @property
+    def bytes(self) -> int:
+        return self.elements * itemsize(self.dtype)
+
+
+@dataclasses.dataclass
+class Instruction:
+    name: str
+    opcode: str
+    shapes: List[Shape]            # result leaf shapes (tuple flattened)
+    operand_shapes: List[Shape]
+    operand_names: List[str]
+    replica_groups: Optional[List[List[int]]]
+    source_target_pairs: Optional[List[Tuple[int, int]]]
+    channel_id: Optional[int]
+    called: List[str]              # calls= / to_apply= / body= targets
+    op_name: str                   # metadata op_name hint ("" if none)
+    index: int                     # position within its computation
+    lhs_contracting: Tuple[int, ...] = ()   # dot contracting dims
+
+
+@dataclasses.dataclass
+class Computation:
+    name: str
+    is_entry: bool
+    instructions: List[Instruction]
+
+
+_SHAPE_RE = re.compile(r"([a-z]\w*)\[([0-9,]*)\]")
+_ATTR_CALL_RE = re.compile(
+    r"(?:calls|to_apply|body|condition|branch_computations)="
+    r"\{?%?([\w.\-]+)")
+_CHANNEL_RE = re.compile(r"channel_id=(\d+)")
+_OPNAME_RE = re.compile(r'metadata=\{[^}]*op_name="([^"]*)"')
+_GROUPS_RE = re.compile(r"replica_groups=(\{\{[^}]*(?:\},\{[^}]*)*\}\}|"
+                        r"\{\}|\[[0-9,]+\]<=\[[0-9,]+\](?:T\([0-9,]+\))?)")
+_PAIRS_RE = re.compile(r"source_target_pairs=(\{\{[^=]*?\}\})")
+_LHS_CONTRACT_RE = re.compile(r"lhs_contracting_dims=\{([0-9,]*)\}")
+_INSTR_RE = re.compile(r"^\s*(?:ROOT\s+)?%?([\w.\-]+)\s+=\s+(.*)$")
+# the param list may hold tuple TYPES with nested parens (while/cond
+# bodies take the loop carry as one tuple param: `(param.7: (s32[],
+# f32[2,8]))`), so the group must span to the line's LAST `)` —
+# `[^)]*` would stop at the first and drop every loop body from the
+# parse, collectives included
+_COMP_RE = re.compile(r"^(ENTRY\s+)?%?([\w.\-]+)(?:\s+\(.*\))?"
+                      r"\s*(?:->.*)?\{\s*$")
+
+
+def _parse_shapes(text: str) -> List[Shape]:
+    """Every `dtype[d,d,...]` shape literal in `text`, in order."""
+    out = []
+    for m in _SHAPE_RE.finditer(text):
+        dtype, dims = m.group(1), m.group(2)
+        if dtype not in _ITEMSIZE and dtype not in ("token", "opaque"):
+            continue
+        out.append(Shape(dtype=dtype,
+                         dims=tuple(int(d) for d in dims.split(",") if d)))
+    return out
+
+
+def _split_result_op(rest: str) -> Tuple[str, str, str]:
+    """Split `<result-type> <opcode>(<operands>), attrs` into
+    (result_type_text, opcode, tail).  The result type may be a tuple
+    `(f32[2]{0}, u32[])` containing spaces — balance parens."""
+    rest = rest.strip()
+    if rest.startswith("("):
+        depth = 0
+        for i, ch in enumerate(rest):
+            if ch == "(":
+                depth += 1
+            elif ch == ")":
+                depth -= 1
+                if depth == 0:
+                    result = rest[: i + 1]
+                    tail = rest[i + 1:].lstrip()
+                    break
+        else:
+            return rest, "", ""
+    else:
+        sp = rest.find(" ")
+        if sp < 0:
+            return rest, "", ""
+        result, tail = rest[:sp], rest[sp + 1:]
+    m = re.match(r"([\w\-]+)\(", tail)
+    if not m:
+        return result, "", ""
+    opcode = m.group(1)
+    return result, opcode, tail[len(opcode):]
+
+
+def _operand_span(tail: str) -> str:
+    """The text inside the opcode's balanced `(...)` operand list."""
+    depth = 0
+    for i, ch in enumerate(tail):
+        if ch == "(":
+            depth += 1
+        elif ch == ")":
+            depth -= 1
+            if depth == 0:
+                return tail[1:i]
+    return tail[1:] if tail.startswith("(") else tail
+
+
+def _parse_replica_groups(text: str) -> Optional[List[List[int]]]:
+    m = _GROUPS_RE.search(text)
+    if not m:
+        return None
+    spec = m.group(1)
+    if spec.startswith("{"):
+        groups = []
+        for g in re.findall(r"\{([0-9,\s]*)\}", spec):
+            ids = [int(x) for x in g.replace(" ", "").split(",") if x]
+            if ids:
+                groups.append(ids)
+        return groups
+    # iota form: [G,S]<=[d0,d1,...](T(p...))? — ids are
+    # arange(prod(d)).reshape(d).transpose(p).reshape(G, S)
+    m2 = re.match(r"\[([0-9,]+)\]<=\[([0-9,]+)\](?:T\(([0-9,]+)\))?",
+                  spec)
+    if not m2:
+        return None
+    gshape = [int(x) for x in m2.group(1).split(",")]
+    rshape = [int(x) for x in m2.group(2).split(",")]
+    perm = ([int(x) for x in m2.group(3).split(",")]
+            if m2.group(3) else list(range(len(rshape))))
+    total = 1
+    for d in rshape:
+        total *= d
+    ids = list(range(total))
+
+    def coord(i):
+        c = []
+        for d in reversed(rshape):
+            c.append(i % d)
+            i //= d
+        return list(reversed(c))
+
+    # transpose: position of id in the permuted layout
+    strides = [0] * len(rshape)
+    acc = 1
+    pshape = [rshape[p] for p in perm]
+    for j in range(len(pshape) - 1, -1, -1):
+        strides[j] = acc
+        acc *= pshape[j]
+    flat = [0] * total
+    for i in ids:
+        c = coord(i)
+        pos = sum(c[p] * strides[j] for j, p in enumerate(perm))
+        flat[pos] = i
+    g, s = gshape if len(gshape) == 2 else (1, gshape[0])
+    return [flat[i * s:(i + 1) * s] for i in range(g)]
+
+
+def _split_top_level(text: str) -> List[str]:
+    """Split an operand list on top-level commas (commas inside shape
+    layouts `{1,0}`, tuple types `(f32[2], u32[])`, and dims `[4,4]`
+    don't count)."""
+    parts, depth, start = [], 0, 0
+    for i, ch in enumerate(text):
+        if ch in "([{":
+            depth += 1
+        elif ch in ")]}":
+            depth -= 1
+        elif ch == "," and depth == 0:
+            parts.append(text[start:i])
+            start = i + 1
+    tail = text[start:]
+    if tail.strip():
+        parts.append(tail)
+    return parts
+
+
+_NAME_TOKEN_RE = re.compile(r"%?([\w.\-]+)\s*$")
+
+
+def _operand_names(operands: str) -> List[str]:
+    """Operand instruction names: the trailing token of each top-level
+    operand.  Optimized dumps spell `f32[64]{0} %conv.4`; pre-opt
+    dumps (`as_text(dialect="hlo")`) spell a bare `conv.4` — both end
+    in the name."""
+    names = []
+    for part in _split_top_level(operands):
+        m = _NAME_TOKEN_RE.search(part.strip())
+        if m:
+            names.append(m.group(1))
+    return names
+
+
+def _parse_pairs(text: str) -> Optional[List[Tuple[int, int]]]:
+    m = _PAIRS_RE.search(text)
+    if not m:
+        return None
+    return [tuple(int(x) for x in p.split(","))
+            for p in re.findall(r"\{(\d+,\d+)\}", m.group(1))]
+
+
+_REPLICA_COUNT_RE = re.compile(r"replica_count=(\d+)")
+_NUM_PARTITIONS_RE = re.compile(r"num_partitions=(\d+)")
+
+
+def parse_world_size(hlo_text: str) -> Optional[int]:
+    """Total participant count from the HloModule header —
+    `replica_count * num_partitions` (SPMD-partitioned jit programs
+    carry num_partitions; pmap-style ones carry replica_count).  None
+    when the header names neither.  Needed because
+    `replica_groups={}` means ONE GROUP OF ALL PARTICIPANTS in HLO,
+    and the group list alone can't say how many that is."""
+    head = hlo_text.split("\n", 1)[0]
+    r = _REPLICA_COUNT_RE.search(head)
+    p = _NUM_PARTITIONS_RE.search(head)
+    if r is None and p is None:
+        return None
+    return (int(r.group(1)) if r else 1) * (int(p.group(1)) if p else 1)
+
+
+def parse_module(hlo_text: str) -> List[Computation]:
+    """Parse an optimized-HLO module dump into computations."""
+    comps: List[Computation] = []
+    current: Optional[Computation] = None
+    producers: Dict[str, Instruction] = {}   # name -> instr, per comp
+    for raw in hlo_text.splitlines():
+        line = raw.rstrip()
+        if current is None:
+            # optimized dumps print `%name (params...) -> type {`;
+            # pre-optimization dumps (`as_text(dialect="hlo")`) print
+            # a bare `name {` — accept both, let _COMP_RE decide
+            if line.endswith("{") and not line.startswith("HloModule"):
+                m = _COMP_RE.match(line.strip())
+                if m:
+                    current = Computation(name=m.group(2),
+                                          is_entry=bool(m.group(1)),
+                                          instructions=[])
+                    producers = {}
+            continue
+        if line.strip() == "}":
+            comps.append(current)
+            current = None
+            continue
+        m = _INSTR_RE.match(line)
+        if not m:
+            continue
+        name, rest = m.group(1), m.group(2)
+        result, opcode, tail = _split_result_op(rest)
+        if not opcode:
+            continue
+        operands = _operand_span(tail)
+        attrs = tail[len(operands) + 2:] if operands else tail
+        operand_names = _operand_names(operands)
+        operand_shapes = _parse_shapes(operands)
+        if not operand_shapes and operand_names:
+            # pre-optimization dumps don't repeat operand types inline
+            # — resolve them from the producing instructions (HLO is
+            # printed in def order within a computation)
+            for ref in operand_names:
+                producer = producers.get(ref)
+                if producer is not None:
+                    operand_shapes.extend(producer.shapes)
+        current.instructions.append(Instruction(
+            name=name, opcode=opcode,
+            shapes=_parse_shapes(result),
+            operand_shapes=operand_shapes,
+            operand_names=operand_names,
+            replica_groups=_parse_replica_groups(attrs),
+            source_target_pairs=_parse_pairs(attrs),
+            channel_id=(int(c.group(1))
+                        if (c := _CHANNEL_RE.search(attrs)) else None),
+            called=_ATTR_CALL_RE.findall(tail),
+            op_name=(o.group(1)
+                     if (o := _OPNAME_RE.search(attrs)) else ""),
+            index=len(current.instructions),
+            lhs_contracting=(tuple(
+                int(x) for x in k.group(1).split(",") if x)
+                if (k := _LHS_CONTRACT_RE.search(attrs)) else ())))
+        producers[name] = current.instructions[-1]
+    return comps
+
+
+def _dot_flops(instr: Instruction) -> float:
+    """2 * prod(output dims) * prod(lhs contracted dims) — exact for
+    batched dots too (batch dims live in the output product)."""
+    if instr.opcode != "dot" or not instr.shapes \
+            or not instr.operand_shapes:
+        return 0.0
+    out = instr.shapes[0].elements
+    lhs = instr.operand_shapes[0]
+    k = 1
+    for d in instr.lhs_contracting:
+        if 0 <= d < len(lhs.dims):
+            k *= lhs.dims[d]
+    return 2.0 * out * k
+
+
+def computation_flops(comps: Sequence[Computation]) -> Dict[str, float]:
+    """Per-computation dot FLOPs, folded transitively through
+    fusion/call/while edges (each called body counted once)."""
+    by_name = {c.name: c for c in comps}
+    memo: Dict[str, float] = {}
+
+    def visit(name: str, stack: frozenset) -> float:
+        if name in memo:
+            return memo[name]
+        comp = by_name.get(name)
+        if comp is None or name in stack:
+            return 0.0
+        total = 0.0
+        for instr in comp.instructions:
+            total += _dot_flops(instr)
+            for callee in instr.called:
+                total += visit(callee, stack | {name})
+        memo[name] = total
+        return total
+
+    for c in comps:
+        visit(c.name, frozenset())
+    return memo
+
+
+def instruction_flops(instr: Instruction,
+                      comp_flops: Dict[str, float]) -> float:
+    """Dot FLOPs attributable to one scheduled instruction (its own
+    dot, plus everything inside the computations it calls)."""
+    total = _dot_flops(instr)
+    for callee in instr.called:
+        total += comp_flops.get(callee, 0.0)
+    return total

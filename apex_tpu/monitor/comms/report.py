@@ -1,0 +1,747 @@
+"""`comms_report(step, args) -> CommsReport` — the collective inventory
++ overlap analysis + ICI roofline of one compiled train step.
+
+The communications half of the compile observatory (ISSUE 7): where
+`analyze_step` answers "what does this program hold" (HBM) and "what
+does it compute" (flops), this answers "what does it SAY over the
+interconnect, and does that talk hide behind compute or serialize
+against it" — the plane ZeRO-3 and the TP-overlap work (ROADMAP 1-2)
+are developed against.
+
+Three layers, all AOT (lower+compile, never execute):
+
+  * inventory — every all-reduce / all-gather / reduce-scatter /
+    all-to-all / collective-permute in the OPTIMIZED module: kind,
+    operand dtype/bytes, replica groups mapped back to the step's mesh
+    axis names, async start/done pairing.
+  * overlap — for each async collective, the instructions scheduled
+    between its start and done, priced as dot FLOPs: a collective
+    whose window holds zero dot flops SERIALIZED (the step waited on
+    the wire).  `async_supported=False` (CPU: XLA emits sync
+    collectives only) means the plane is unmeasurable, reported as
+    such — never faked.
+  * roofline — each collective priced analytically against the
+    per-device-kind ICI table (`roofline.collective_seconds`),
+    totalled into predicted comm seconds, the comm fraction of the
+    step (vs flops/peak compute time), and a comm-bound verdict.
+
+`scripts/comms_probe.py` turns the serialized classification into a CI
+gate; `crosscheck_rank_timing` closes the loop against the measured
+allreduce durations the rank-timing plane (`TraceConfig(
+rank_timing=True)`) already gathers at runtime.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import fnmatch
+import math
+from typing import Any, List, Optional, Sequence, Tuple
+
+from apex_tpu.monitor.comms import hlo as hlo_lib
+from apex_tpu.monitor.comms import roofline as roofline_lib
+# one byte formatter for the whole observatory — the comms table
+# prints next to the HBM budget and both must agree what "16.00 MiB"
+# is (compile.report does not import comms at module level, so this
+# cannot cycle)
+from apex_tpu.monitor.compile.report import _human_bytes
+
+# Bump on any Collective/CommsReport field add/rename/re-semantics —
+# scripts/comms_probe.py --selftest renders the committed fixture
+# (scripts/comms_fixture.json) and exits nonzero on drift, same
+# contract as the flight recorder's and the linter's.
+COMMS_SCHEMA_VERSION = 1
+
+# a collective smaller than this is never expected to overlap (scalar
+# loss pmeans, found_inf psum-ORs, the rank-timing all_gather): hiding
+# a 4-byte flag behind a GEMM is noise, not a lever
+OVERLAP_BYTES_FLOOR = 1 << 20  # 1 MiB
+
+# the kinds the overlap gate holds to the expected-overlap rule;
+# collective-permute windows are usually latency- not bandwidth-bound
+# and all-to-all overlap is workload-specific (MoE lands later)
+_EXPECTED_OVERLAP_KINDS = ("all-reduce", "all-gather", "reduce-scatter")
+
+
+@dataclasses.dataclass
+class Collective:
+    """One collective of the optimized module (JSON-able via to_dict).
+
+    `operand_bytes` is the total input bytes (for an all-gather: this
+    rank's shard — see roofline.py for what each kind's formula does
+    with it).  `axes` is the mesh-axis tuple the replica groups span
+    (() = degenerate single-device groups, None = unmappable — no mesh
+    info, or ids outside the mesh).  `overlap_fraction` is None for a
+    sync collective (no start/done window to classify), else the
+    fraction of the predicted comm time covered by dot FLOPs scheduled
+    inside the window, clamped to 1."""
+
+    name: str
+    kind: str
+    dtype: str
+    operand_bytes: int
+    output_bytes: int
+    group_size: int
+    n_groups: int
+    axes: Optional[Tuple[str, ...]]
+    async_pair: bool
+    n_between: int
+    overlapped_flops: float
+    predicted_s: float
+    overlap_fraction: Optional[float]
+    expected_overlap: bool
+    serialized: bool
+    op_name: str
+
+    def to_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["axes"] = None if self.axes is None else list(self.axes)
+        return d
+
+
+@dataclasses.dataclass
+class CommsReport:
+    """The step's communication anatomy (JSON-able via to_dict)."""
+
+    backend: str
+    device_kind: Optional[str]
+    mesh_axis_names: Optional[Tuple[str, ...]]
+    mesh_axis_sizes: Optional[Tuple[int, ...]]
+    collectives: List[Collective]
+    # aggregates over NON-degenerate collectives (group_size > 1)
+    counts: dict                     # kind -> count
+    bytes_by_kind: dict              # kind -> total operand bytes
+    total_comm_bytes: int
+    # roofline
+    link_bandwidth: float
+    bandwidth_source: str            # "override" | "table:<kind>" | "default"
+    predicted_comm_s: float
+    compute_s: Optional[float]       # xla flops / device peak (None: no
+    comm_fraction: Optional[float]   # cost analysis on this backend)
+    comm_bound: Optional[bool]
+    # overlap plane
+    async_supported: bool            # any start/done pair in the module
+    serialized_comm_bytes: int
+    overlap_ok: bool                 # vacuously True when not measurable
+
+    def to_dict(self) -> dict:
+        return {
+            "comms_schema_version": COMMS_SCHEMA_VERSION,
+            "backend": self.backend,
+            "device_kind": self.device_kind,
+            "mesh_axis_names": (None if self.mesh_axis_names is None
+                                else list(self.mesh_axis_names)),
+            "mesh_axis_sizes": (None if self.mesh_axis_sizes is None
+                                else list(self.mesh_axis_sizes)),
+            "collectives": [c.to_dict() for c in self.collectives],
+            "counts": dict(self.counts),
+            "bytes_by_kind": dict(self.bytes_by_kind),
+            "total_comm_bytes": int(self.total_comm_bytes),
+            "link_bandwidth": float(self.link_bandwidth),
+            "bandwidth_source": self.bandwidth_source,
+            "predicted_comm_s": float(self.predicted_comm_s),
+            "compute_s": self.compute_s,
+            "comm_fraction": self.comm_fraction,
+            "comm_bound": self.comm_bound,
+            "async_supported": bool(self.async_supported),
+            "serialized_comm_bytes": int(self.serialized_comm_bytes),
+            "overlap_ok": bool(self.overlap_ok),
+        }
+
+
+# ----------------------- replica-group -> mesh axes -----------------------
+
+def _unravel(i: int, sizes: Sequence[int]) -> Optional[Tuple[int, ...]]:
+    total = 1
+    for s in sizes:
+        total *= s
+    if not (0 <= i < total):
+        return None
+    coords = []
+    for s in reversed(sizes):
+        coords.append(i % s)
+        i //= s
+    return tuple(reversed(coords))
+
+
+def _axes_for_groups(groups, axis_names, axis_sizes):
+    """Map replica groups to the mesh axes they span.
+
+    Group ids are LOGICAL device indices of the program's device
+    assignment, which for a jit over a Mesh is the row-major flatten of
+    `mesh.devices` — so `unravel(id, axis_sizes)` is the device's mesh
+    coordinate.  The group's axes = the coordinates that vary within a
+    group.  Returns () for degenerate single-member groups and None
+    when no mesh info was given or an id falls outside the mesh."""
+    if axis_names is None or axis_sizes is None \
+            or len(axis_names) != len(axis_sizes):
+        return None
+    varying = set()
+    for g in groups:
+        coords = []
+        for i in g:
+            c = _unravel(int(i), axis_sizes)
+            if c is None:
+                return None
+            coords.append(c)
+        for dim in range(len(axis_sizes)):
+            if len({c[dim] for c in coords}) > 1:
+                varying.add(dim)
+    return tuple(axis_names[d] for d in sorted(varying))
+
+
+# ------------------------------ inventory ------------------------------
+
+def _comp_collective_kind(comp) -> Optional[str]:
+    for instr in comp.instructions:
+        if instr.opcode in hlo_lib.COLLECTIVE_KINDS:
+            return instr.opcode
+    return None
+
+
+def _resolve_kind(instr, kinds_by_comp) -> Optional[str]:
+    """Collective kind of a start/done/sync instruction, or None."""
+    op = instr.opcode
+    if op in hlo_lib.COLLECTIVE_KINDS:
+        return op
+    for kind in hlo_lib.COLLECTIVE_KINDS:
+        if op in (f"{kind}-start", f"{kind}-done"):
+            return kind
+    if op in ("async-start", "async-done", "async-update"):
+        for callee in instr.called:
+            k = kinds_by_comp.get(callee)
+            if k:
+                return k
+    return None
+
+
+def inventory_from_hlo(hlo_text: str, *,
+                       mesh_axis_names=None, mesh_axis_sizes=None,
+                       peak_flops: float,
+                       link_bandwidth: float,
+                       overlap_bytes_floor: int = OVERLAP_BYTES_FLOOR,
+                       ) -> Tuple[List[Collective], bool]:
+    """Parse one optimized-HLO module into the collective inventory.
+
+    Returns (collectives, async_supported).  Pure text analysis — the
+    unit the committed-fixture tests exercise without a backend."""
+    comps = hlo_lib.parse_module(hlo_text)
+    comp_flops = hlo_lib.computation_flops(comps)
+    # `replica_groups={}` means one group of ALL participants — the
+    # total comes from the mesh when we have one, else the module
+    # header (replica_count / num_partitions)
+    world = None
+    if mesh_axis_sizes:
+        world = 1
+        for s in mesh_axis_sizes:
+            world *= int(s)
+    if world is None:
+        world = hlo_lib.parse_world_size(hlo_text)
+    by_name = {c.name: c for c in comps}
+    kinds_by_comp = {c.name: _comp_collective_kind(c) for c in comps}
+    # computations wrapped by async-start/done instructions: their
+    # inner collective is the async op's body, not a second collective
+    async_wrapped = set()
+    for comp in comps:
+        for instr in comp.instructions:
+            if instr.opcode.startswith("async-"):
+                async_wrapped.update(instr.called)
+    out: List[Collective] = []
+    async_supported = False
+
+    for comp in comps:
+        starts = {}           # instr name -> (kind, instr)
+        done_for = {}         # start name -> done instr
+        alias = {}            # async-update name -> its chain's start
+        sync = []
+        for instr in comp.instructions:
+            op = instr.opcode
+            if op.endswith("-done"):
+                # pairing is by start-name reference — possibly
+                # through an async-update chain (the done's operand is
+                # the LAST update, not the start); the done op itself
+                # often carries neither groups nor calls=
+                for ref in instr.operand_names:
+                    root = alias.get(ref, ref)
+                    if root in starts:
+                        done_for[root] = instr
+                        break
+                continue
+            if op.endswith("-update"):
+                # bridge start -> update -> ... -> done: without the
+                # alias the window would run to the end of the
+                # computation and a serialized collective would count
+                # post-done dots as overlap
+                for ref in instr.operand_names:
+                    root = alias.get(ref, ref)
+                    if root in starts:
+                        alias[instr.name] = root
+                        break
+                continue
+            kind = _resolve_kind(instr, kinds_by_comp)
+            if kind is None:
+                continue
+            if op.endswith("-start"):
+                starts[instr.name] = (kind, instr)
+            elif comp.name in async_wrapped:
+                pass  # the wrapper's start/done entry covers it
+            else:
+                sync.append((kind, instr))
+
+        for name, (kind, start) in starts.items():
+            async_supported = True
+            done = done_for.get(name)
+            end_idx = done.index if done is not None \
+                else len(comp.instructions)
+            window = comp.instructions[start.index + 1:end_idx]
+            flops_between = sum(
+                hlo_lib.instruction_flops(w, comp_flops) for w in window)
+            # an async-start wrapper carries no replica_groups itself;
+            # the inner collective (inside the called computation) does
+            detail = start
+            if start.replica_groups is None \
+                    and start.source_target_pairs is None:
+                for callee in start.called:
+                    inner_comp = by_name.get(callee)
+                    if inner_comp is None:
+                        continue
+                    for inner in inner_comp.instructions:
+                        if inner.opcode in hlo_lib.COLLECTIVE_KINDS:
+                            detail = inner
+                            break
+            out.append(_build(kind, start, done, mesh_axis_names,
+                              mesh_axis_sizes, peak_flops,
+                              link_bandwidth, overlap_bytes_floor,
+                              async_pair=True,
+                              n_between=len(window),
+                              overlapped_flops=flops_between,
+                              detail=detail, world=world))
+        for kind, instr in sync:
+            out.append(_build(kind, instr, None, mesh_axis_names,
+                              mesh_axis_sizes, peak_flops,
+                              link_bandwidth, overlap_bytes_floor,
+                              async_pair=False, n_between=0,
+                              overlapped_flops=0.0, world=world))
+    return out, async_supported
+
+
+def _build(kind, instr, done, axis_names, axis_sizes, peak_flops,
+           link_bandwidth, floor, *, async_pair, n_between,
+           overlapped_flops, detail=None, world=None) -> Collective:
+    detail = detail if detail is not None else instr
+    operand_bytes = sum(s.bytes for s in instr.operand_shapes)
+    result = done if done is not None else instr
+    output_bytes = sum(s.bytes for s in result.shapes)
+    dtype = (instr.operand_shapes[0].dtype if instr.operand_shapes
+             else (instr.shapes[0].dtype if instr.shapes else "?"))
+    if detail.source_target_pairs is not None:
+        pairs = detail.source_target_pairs
+        groups = [list(p) for p in pairs]
+        group_size = 2 if pairs else 1
+        n_groups = len(pairs)
+    else:
+        groups = detail.replica_groups or []
+        if not groups and detail.replica_groups is not None \
+                and world and world > 1:
+            # `replica_groups={}` = ONE group of ALL participants —
+            # NOT a degenerate collective; expand it so a global
+            # all-reduce is counted, priced, and gated
+            groups = [list(range(world))]
+        group_size = max((len(g) for g in groups), default=1)
+        n_groups = len(groups)
+    axes = _axes_for_groups(groups, axis_names, axis_sizes) \
+        if groups else ()
+    predicted = roofline_lib.collective_seconds(
+        kind, operand_bytes, group_size, link_bandwidth)
+    expected = (async_pair and kind in _EXPECTED_OVERLAP_KINDS
+                and group_size > 1 and operand_bytes >= floor)
+    if not async_pair:
+        frac = None
+    elif predicted > 0:
+        frac = min(1.0, (overlapped_flops / peak_flops) / predicted)
+    else:
+        frac = 1.0 if overlapped_flops > 0 else 0.0
+    return Collective(
+        name=instr.name, kind=kind, dtype=dtype,
+        operand_bytes=int(operand_bytes), output_bytes=int(output_bytes),
+        group_size=int(group_size), n_groups=int(n_groups), axes=axes,
+        async_pair=bool(async_pair), n_between=int(n_between),
+        overlapped_flops=float(overlapped_flops),
+        predicted_s=float(predicted), overlap_fraction=frac,
+        expected_overlap=bool(expected),
+        serialized=bool(expected and overlapped_flops == 0),
+        op_name=(instr.op_name or detail.op_name)[:160])
+
+
+# ------------------------------ the report ------------------------------
+
+def comms_report(step_fn=None, args: Sequence[Any] = (), *,
+                 compiled=None, hlo_text: Optional[str] = None,
+                 optimized: bool = True,
+                 mesh=None, mesh_axis_names=None, mesh_axis_sizes=None,
+                 device_kind: Optional[str] = None,
+                 bandwidth_override: Optional[float] = None,
+                 overlap_bytes_floor: int = OVERLAP_BYTES_FLOOR,
+                 ) -> CommsReport:
+    """Lower + compile `step_fn(*args)` WITHOUT executing and inventory
+    its collectives.
+
+    step_fn: anything with `.lower(*args)` — a jitted function or a
+    builder-attached step (whose `mesh_axis_names`/`mesh_axis_sizes`
+    label the replica-group mapping automatically).  args may be real
+    arrays or ShapeDtypeStructs, exactly like `analyze_step`.
+
+    compiled: skip the compile and reuse an existing executable (what
+    `analyze_step(..., comms=True)` passes so the audit compiles
+    ONCE).  hlo_text: skip the backend entirely and analyze a saved
+    optimized-HLO dump.  mesh: a `jax.sharding.Mesh` to read axis
+    names/sizes from; explicit mesh_axis_names/mesh_axis_sizes win
+    over both the mesh and the step attributes.
+
+    optimized=False inventories the PRE-optimization HLO
+    (`lower(...).as_text(dialect="hlo")` — no compile at all) instead.
+    Use it for authored-dtype claims on non-TPU backends: CPU XLA's
+    float-normalization pass rewrites every bf16 collective to f32
+    with converts at the boundaries, so the optimized module's wire
+    dtype there is a backend artifact, while the pre-opt module keeps
+    the dtypes the program actually wrote (and a TPU run keeps bf16
+    end to end).  No schedule exists pre-optimization, so the overlap
+    plane reports `async_supported=False` and there is no cost
+    analysis to derive a comm fraction from.
+    """
+    import jax
+
+    if compiled is not None and not optimized:
+        raise ValueError(
+            "comms_report(compiled=..., optimized=False) is "
+            "contradictory: an executable only carries the OPTIMIZED "
+            "module (on CPU its bf16 collectives are already "
+            "float-normalized to f32) — pass the step/args instead so "
+            "the pre-optimization HLO can be read from .lower()")
+    if hlo_text is None:
+        if compiled is None:
+            lower = getattr(step_fn, "lower", None)
+            if lower is None:
+                raise TypeError(
+                    f"{type(step_fn).__name__} has no .lower — pass a "
+                    "jitted function or a step built by "
+                    "ddp.make_train_step / make_tp_dp_train_step")
+            if optimized:
+                compiled = lower(*args).compile()
+            else:
+                hlo_text = lower(*args).as_text(dialect="hlo")
+        if hlo_text is None:
+            hlo_text = compiled.as_text()
+
+    if mesh_axis_names is None:
+        if mesh is not None:
+            mesh_axis_names = tuple(str(a) for a in mesh.axis_names)
+        else:
+            mesh_axis_names = getattr(step_fn, "mesh_axis_names", None)
+    if mesh_axis_sizes is None:
+        if mesh is not None:
+            mesh_axis_sizes = tuple(
+                int(s) for s in mesh.devices.shape)
+        else:
+            mesh_axis_sizes = getattr(step_fn, "mesh_axis_sizes", None)
+    if mesh_axis_names is not None:
+        mesh_axis_names = tuple(mesh_axis_names)
+    if mesh_axis_sizes is not None:
+        mesh_axis_sizes = tuple(int(s) for s in mesh_axis_sizes)
+
+    backend = jax.default_backend()
+    if device_kind is None:
+        try:
+            device_kind = jax.devices()[0].device_kind
+        except Exception:
+            device_kind = None
+
+    from apex_tpu.monitor import flops as flops_lib
+    peak = flops_lib.device_peak_flops(device_kind)
+    bw, bw_src = roofline_lib.resolve_link_bandwidth(
+        device_kind, override=bandwidth_override)
+
+    collectives, async_supported = inventory_from_hlo(
+        hlo_text, mesh_axis_names=mesh_axis_names,
+        mesh_axis_sizes=mesh_axis_sizes, peak_flops=peak,
+        link_bandwidth=bw, overlap_bytes_floor=overlap_bytes_floor)
+
+    counts: dict = {}
+    bytes_by_kind: dict = {}
+    total = 0
+    predicted = 0.0
+    serialized_bytes = 0
+    for c in collectives:
+        if c.group_size <= 1:
+            continue  # degenerate (tp=1 psum etc.) — listed, not counted
+        counts[c.kind] = counts.get(c.kind, 0) + 1
+        bytes_by_kind[c.kind] = bytes_by_kind.get(c.kind, 0) \
+            + c.operand_bytes
+        total += c.operand_bytes
+        predicted += c.predicted_s
+        if c.serialized:
+            serialized_bytes += c.operand_bytes
+
+    compute_s = comm_fraction = comm_bound = None
+    if compiled is not None:
+        from apex_tpu.monitor.compile.report import _cost_entry
+        cost = _cost_entry(compiled)
+        xla_flops = cost.get("flops") if cost else None
+        # `is not None`: flops == 0.0 is a real answer (a collective-only
+        # program is 100% comm-bound), not a missing cost analysis
+        if xla_flops is not None:
+            compute_s = float(xla_flops) / peak
+    if compute_s is not None:
+        denom = compute_s + predicted
+        comm_fraction = predicted / denom if denom > 0 else 0.0
+        comm_bound = predicted > compute_s
+
+    return CommsReport(
+        backend=backend, device_kind=device_kind,
+        mesh_axis_names=mesh_axis_names, mesh_axis_sizes=mesh_axis_sizes,
+        collectives=collectives, counts=counts,
+        bytes_by_kind=bytes_by_kind, total_comm_bytes=total,
+        link_bandwidth=bw, bandwidth_source=bw_src,
+        predicted_comm_s=predicted, compute_s=compute_s,
+        comm_fraction=comm_fraction, comm_bound=comm_bound,
+        async_supported=async_supported,
+        serialized_comm_bytes=serialized_bytes,
+        overlap_ok=not any(c.serialized for c in collectives))
+
+
+# ---------------------------- schema + gate ----------------------------
+
+_REPORT_FIELDS = {
+    "comms_schema_version": int, "backend": str,
+    "device_kind": (str, type(None)),
+    "mesh_axis_names": (list, type(None)),
+    "mesh_axis_sizes": (list, type(None)),
+    "collectives": list, "counts": dict, "bytes_by_kind": dict,
+    "total_comm_bytes": int, "link_bandwidth": (int, float),
+    "bandwidth_source": str, "predicted_comm_s": (int, float),
+    "compute_s": (int, float, type(None)),
+    "comm_fraction": (int, float, type(None)),
+    "comm_bound": (bool, type(None)),
+    "async_supported": bool, "serialized_comm_bytes": int,
+    "overlap_ok": bool,
+}
+
+_COLLECTIVE_FIELDS = {
+    "name": str, "kind": str, "dtype": str, "operand_bytes": int,
+    "output_bytes": int, "group_size": int, "n_groups": int,
+    "axes": (list, type(None)), "async_pair": bool, "n_between": int,
+    "overlapped_flops": (int, float), "predicted_s": (int, float),
+    "overlap_fraction": (int, float, type(None)),
+    "expected_overlap": bool, "serialized": bool, "op_name": str,
+}
+
+
+def validate_comms_report(report: dict) -> None:
+    """Raise ValueError unless `report` (the to_dict form) matches the
+    current schema — the drift gate `comms_probe.py --selftest` runs
+    over the committed fixture."""
+    if not isinstance(report, dict):
+        raise ValueError(f"comms report must be a dict, got "
+                         f"{type(report).__name__}")
+    if report.get("comms_schema_version") != COMMS_SCHEMA_VERSION:
+        raise ValueError(
+            f"comms_schema_version "
+            f"{report.get('comms_schema_version')!r} != "
+            f"{COMMS_SCHEMA_VERSION}")
+    for name, typ in _REPORT_FIELDS.items():
+        if name not in report:
+            raise ValueError(f"missing comms report field {name!r}")
+        v = report[name]
+        if not isinstance(v, typ):
+            raise ValueError(f"comms report field {name!r} is "
+                             f"{type(v).__name__}")
+        if not isinstance(typ, tuple) and typ in (int,) \
+                and isinstance(v, bool):
+            raise ValueError(f"comms report field {name!r} is bool")
+    for i, c in enumerate(report["collectives"]):
+        for name, typ in _COLLECTIVE_FIELDS.items():
+            if name not in c:
+                raise ValueError(
+                    f"collective[{i}] missing field {name!r}")
+            if not isinstance(c[name], typ):
+                raise ValueError(
+                    f"collective[{i}].{name} is "
+                    f"{type(c[name]).__name__}")
+        if c["kind"] not in hlo_lib.COLLECTIVE_KINDS:
+            raise ValueError(f"collective[{i}] unknown kind "
+                             f"{c['kind']!r}")
+
+
+def serialized_collectives(report) -> List[dict]:
+    """The gate's findings: expected-overlap collectives whose async
+    window held zero dot flops.  Accepts a CommsReport or its dict."""
+    d = report.to_dict() if hasattr(report, "to_dict") else report
+    return [c for c in d["collectives"] if c.get("serialized")]
+
+
+def parse_allowlist(text: str) -> List[Tuple[str, str]]:
+    """`KIND location-glob` lines (fnmatch; `#` comments) accepting
+    deliberately serialized collectives out of the gate — the format
+    of scripts/lint_allowlist.txt, with collective kinds as the rule
+    column.  The committed scripts/comms_allowlist.txt starts EMPTY."""
+    entries = []
+    for ln, raw in enumerate(text.splitlines(), 1):
+        line = raw.split("#", 1)[0].strip()
+        if not line:
+            continue
+        parts = line.split(None, 1)
+        kind = parts[0]
+        if kind not in hlo_lib.COLLECTIVE_KINDS:
+            raise ValueError(
+                f"comms allowlist line {ln}: unknown collective kind "
+                f"{kind!r}")
+        glob = parts[1].strip() if len(parts) > 1 else "*"
+        entries.append((kind, glob))
+    return entries
+
+
+def apply_allowlist(findings: Sequence[dict], entries, target: str):
+    """Split serialized-collective findings into (new, allowlisted);
+    the glob matches `target:instruction-name`."""
+    new, allowed = [], []
+    for f in findings:
+        loc = f"{target}:{f.get('name', '')}"
+        if any(k == f.get("kind") and fnmatch.fnmatch(loc, g)
+               for k, g in entries):
+            allowed.append(f)
+        else:
+            new.append(f)
+    return new, allowed
+
+
+# ---------------------------- rendering ----------------------------
+
+
+def _human_s(s) -> str:
+    if s is None or not math.isfinite(s):
+        return "n/a"
+    if s >= 1.0:
+        return f"{s:.2f} s"
+    if s >= 1e-3:
+        return f"{s * 1e3:.2f} ms"
+    return f"{s * 1e6:.0f} us"
+
+
+def render_comms_table(report, label: str = "step") -> str:
+    """The comms table an operator reads next to the HBM budget.
+    Accepts a CommsReport or its to_dict() (the crash-dump form)."""
+    r = report.to_dict() if hasattr(report, "to_dict") else dict(report)
+    mesh = ""
+    if r.get("mesh_axis_names") and r.get("mesh_axis_sizes"):
+        mesh = " | mesh " + "x".join(
+            f"{n}={s}" for n, s in zip(r["mesh_axis_names"],
+                                       r["mesh_axis_sizes"]))
+    lines = [
+        f"=== comms: {label} ===",
+        f"backend: {r.get('backend')}"
+        + (f" ({r['device_kind']})" if r.get("device_kind") else "")
+        + mesh
+        + f" | ICI {r.get('link_bandwidth', 0) / 1e9:.0f} GB/s"
+        + f" ({r.get('bandwidth_source')})",
+        "| kind               | dtype |      bytes | axes   | n | "
+        "async | overlap | predicted |",
+        "|---|---|---|---|---|---|---|---|",
+    ]
+    for c in r.get("collectives", []):
+        if c.get("group_size", 1) <= 1:
+            continue
+        axes = ("?" if c.get("axes") is None
+                else ",".join(c["axes"]) or "-")
+        frac = c.get("overlap_fraction")
+        overlap = ("sync" if not c.get("async_pair")
+                   else f"{100 * frac:.0f}%" if frac is not None
+                   else "?")
+        mark = " **SER**" if c.get("serialized") else ""
+        lines.append(
+            f"| {c['kind']:<18} | {c['dtype']:<5} | "
+            f"{_human_bytes(c['operand_bytes']):>10} | {axes:<6} | "
+            f"{c['group_size']} | {str(c['async_pair']).lower():<5} | "
+            f"{overlap:>7} | {_human_s(c['predicted_s']):>9} |{mark}")
+    n_deg = sum(1 for c in r.get("collectives", [])
+                if c.get("group_size", 1) <= 1)
+    counts = r.get("counts") or {}
+    by_kind = ", ".join(f"{k} x{v}" for k, v in sorted(counts.items()))
+    lines.append(
+        f"totals: {sum(counts.values())} collective(s) "
+        f"({by_kind or 'none'}), "
+        f"{_human_bytes(r.get('total_comm_bytes', 0))}"
+        + (f"; {n_deg} degenerate single-device group(s) not counted"
+           if n_deg else ""))
+    comp = r.get("compute_s")
+    if comp is not None and r.get("comm_fraction") is not None:
+        verdict = "COMM-BOUND" if r.get("comm_bound") else "compute-bound"
+        lines.append(
+            f"roofline: predicted comm {_human_s(r['predicted_comm_s'])}"
+            f" vs compute {_human_s(comp)} — "
+            f"{100 * r['comm_fraction']:.0f}% of step, {verdict}")
+    else:
+        lines.append(
+            f"roofline: predicted comm "
+            f"{_human_s(r.get('predicted_comm_s'))} "
+            "(no cost analysis on this backend — comm fraction n/a)")
+    if not r.get("async_supported"):
+        lines.append(
+            "overlap: not measurable (no async start/done pairs — "
+            "this backend emits sync collectives; run on TPU for the "
+            "schedule truth)")
+    elif r.get("overlap_ok"):
+        lines.append("overlap: ok (every expected-overlap collective's "
+                     "window holds compute)")
+    else:
+        ser = serialized_collectives(r)
+        lines.append(
+            f"** {len(ser)} SERIALIZED collective(s) "
+            f"({_human_bytes(r.get('serialized_comm_bytes', 0))}): "
+            + "; ".join(f"{c['kind']} {c['name']} "
+                        f"{_human_bytes(c['operand_bytes'])}"
+                        for c in ser[:4]))
+    return "\n".join(lines)
+
+
+# ------------------------- runtime cross-check -------------------------
+
+def crosscheck_rank_timing(report, timings, *,
+                           field: Optional[int] = None) -> dict:
+    """Close the loop between the AOT roofline and what the step
+    actually measured: `timings` is the gathered (n_ranks, k) matrix
+    the rank-timing plane (`TraceConfig(rank_timing=True)`) returns.
+    `field` defaults to the `allreduce_duration_s` column, resolved
+    from `trace.TIMING_FIELDS` by NAME so a column reorder there can't
+    silently repoint this at step time.  Returns the measured median
+    across ranks,
+    the report's predicted comm seconds, and their ratio — a measured/
+    predicted ratio far above ~1.5 means the table bandwidth is
+    optimistic for this topology (or the collective serialized behind
+    something the roofline can't see); far below 1 means the table
+    under-quotes the links and should be refreshed with an override."""
+    import numpy as np
+
+    if field is None:
+        from apex_tpu.monitor.trace import TIMING_FIELDS
+        field = TIMING_FIELDS.index("allreduce_duration_s")
+    r = report.to_dict() if hasattr(report, "to_dict") else dict(report)
+    t = np.asarray(timings, np.float64)
+    if t.ndim == 1:
+        col = t  # a bare per-rank allreduce-duration vector
+    elif field < t.shape[1]:
+        col = t[:, field]
+    else:
+        # never silently repoint at another column (step time would
+        # inflate the ratio and tell the operator the table is wrong)
+        raise ValueError(
+            f"timings has {t.shape[1]} column(s); column {field} "
+            "(allreduce_duration_s) is missing — pass the full "
+            "TIMING_FIELDS matrix or a 1-D allreduce vector")
+    measured = float(np.median(col))
+    predicted = float(r.get("predicted_comm_s") or 0.0)
+    return {
+        "measured_s": measured,
+        "predicted_comm_s": predicted,
+        "ratio": (measured / predicted) if predicted > 0 else None,
+        "n_ranks": int(col.shape[0]),
+    }

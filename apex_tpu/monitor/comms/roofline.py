@@ -1,0 +1,112 @@
+"""ICI link-bandwidth table + analytic collective pricing.
+
+The comms sibling of `monitor.flops.DEVICE_BF16_PEAKS`: a per-device-
+generation interconnect bandwidth table and the standard ring-algorithm
+cost formulas, so every collective in the inventory gets a predicted
+wall-clock BEFORE the step ever runs — the number the overlap analysis
+and the comm-bound/compute-bound verdict divide by.
+
+Bandwidth convention: BYTES/SECOND of aggregate per-chip ICI
+bandwidth, from the public TPU spec sheets (quoted there in Gbps of
+total interchip bandwidth per chip; /8 for bytes).  These are LINK
+peaks, not achieved collective bandwidth — real rings see ~70-90% of
+link peak depending on topology (2D/3D torus wraparound, slice shape)
+and message size.  Treat the predictions as a roofline: a collective
+predicted at 2 ms will not run in 1 ms, and a measured 10 ms against a
+2 ms prediction is a finding.  On real hardware, refresh against a
+measured number via `device_link_bandwidth(override=...)` and the
+rank-timing cross-check (`crosscheck_rank_timing`) — docs/
+observability.md "Reading the comms table" says where to measure.
+
+Ring-algorithm cost model over n participants for D bytes of *input*
+(the operand bytes the inventory already extracted):
+
+    all-reduce          2 (n-1)/n * D / bw     (reduce-scatter + all-gather phases)
+    reduce-scatter        (n-1)/n * D / bw     (D = full un-scattered input)
+    all-gather            (n-1)   * D / bw     (D = this rank's shard; output = n*D)
+    all-to-all            (n-1)/n * D / bw
+    collective-permute              D / bw     (one hop, full operand)
+
+n == 1 collectives (a tp collective on a tp=1 mesh) cost 0 — degenerate
+by construction, XLA compiles most of them away anyway.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from apex_tpu.monitor.flops import _normalize_device_kind
+
+# v5e aggregate ICI per chip — the fallback for unknown kinds (CPU test
+# runs included), mirroring flops.V5E_BF16_PEAK's role: predictions on
+# unknown backends are stable and clearly table-priced, never zero.
+V5E_ICI_BYTES_PER_S = 200e9  # 1600 Gbps
+
+# normalized device generation -> aggregate per-chip ICI bytes/s.
+# Sources: public TPU spec sheets (interchip interconnect bandwidth per
+# chip, all links): v2 496 Gbps, v3 656 Gbps, v4 2400 Gbps, v5e 1600
+# Gbps, v5p 4800 Gbps, v6e 3584 Gbps.
+DEVICE_ICI_BANDWIDTH = {
+    "v2": 62e9,
+    "v3": 82e9,
+    "v4": 300e9,
+    "v5e": 200e9,
+    "v5p": 600e9,
+    "v6e": 448e9,
+}
+
+
+def resolve_link_bandwidth(device_kind: Optional[str], *,
+                           override: Optional[float] = None,
+                           default: float = V5E_ICI_BYTES_PER_S,
+                           ) -> "tuple[float, str]":
+    """(bytes/s, source) with source one of "override" /
+    "table:<kind>" / "default" — the single resolution path both
+    `device_link_bandwidth` and `comms_report` price against, so a
+    new device generation lands in one table."""
+    if override is not None:
+        return float(override), "override"
+    norm = _normalize_device_kind(str(device_kind or ""))
+    if norm in DEVICE_ICI_BANDWIDTH:
+        return DEVICE_ICI_BANDWIDTH[norm], f"table:{norm}"
+    return float(default), "default"
+
+
+def device_link_bandwidth(device_kind: Optional[str] = None, *,
+                          override: Optional[float] = None,
+                          default: float = V5E_ICI_BYTES_PER_S) -> float:
+    """Aggregate per-chip ICI bytes/s, resolved from the device kind.
+
+    Same contract as `flops.device_peak_flops`: `override` wins
+    outright (a measured ring bandwidth, a sliced topology);
+    device_kind=None reads `jax.devices()[0].device_kind`; unknown
+    kinds — CPU included — fall back to the v5e number so CPU-run
+    predictions are stable table prices, not zeros."""
+    if override is None and device_kind is None:
+        try:
+            import jax
+            device_kind = jax.devices()[0].device_kind
+        except Exception:
+            return default
+    return resolve_link_bandwidth(device_kind, override=override,
+                                  default=default)[0]
+
+
+def collective_seconds(kind: str, operand_bytes: int, group_size: int,
+                       bandwidth: float) -> float:
+    """Predicted ring-algorithm seconds for one collective (see module
+    docstring for the per-kind formulas and what D means for each)."""
+    n, d = int(group_size), float(operand_bytes)
+    if n <= 1 or d <= 0 or bandwidth <= 0:
+        return 0.0
+    if kind == "all-reduce":
+        return 2.0 * (n - 1) / n * d / bandwidth
+    if kind == "reduce-scatter":
+        return (n - 1) / n * d / bandwidth
+    if kind == "all-gather":
+        return (n - 1) * d / bandwidth
+    if kind == "all-to-all":
+        return (n - 1) / n * d / bandwidth
+    if kind == "collective-permute":
+        return d / bandwidth
+    return d / bandwidth  # unknown kind: one full traversal

@@ -85,6 +85,14 @@ class CompileReport:
     # dies with the lint verdict alongside the HBM budget.  None when
     # linting was not requested.
     lint: Optional[dict] = None
+    # comms attachment (ISSUE 7): analyze_step(..., comms=True) runs
+    # monitor.comms' collective inventory + overlap analysis + ICI
+    # roofline over the SAME compiled executable (no second compile)
+    # and stores the CommsReport.to_dict() here — the crash dump then
+    # carries the communication anatomy alongside the HBM budget, with
+    # no recorder schema change (the field rides inside this report,
+    # exactly like `lint`).  None when comms was not requested.
+    comms: Optional[dict] = None
 
     def to_dict(self) -> dict:
         """Flat JSON-able dict (what the flight recorder attaches)."""
@@ -149,7 +157,8 @@ def analyze_step(step_fn, args: Sequence[Any], *,
                  analytic_flops: Optional[float] = None,
                  flops_tol: float = 0.10,
                  donation_tol: float = DONATION_TOL,
-                 lint: bool = False) -> CompileReport:
+                 lint: bool = False,
+                 comms: bool = False) -> CompileReport:
     """Lower + compile `step_fn(*args)` WITHOUT executing and return
     the `CompileReport`.
 
@@ -170,6 +179,11 @@ def analyze_step(step_fn, args: Sequence[Any], *,
     against THIS report's donation_ok) over the same step/args and
     attach the result as `report.lint` — so a crash dump carrying the
     report carries the lint verdict too.
+    comms: also run `monitor.comms`' collective inventory + overlap
+    analysis + ICI roofline over the SAME compiled executable (reused
+    — no second XLA compile) and attach `CommsReport.to_dict()` as
+    `report.comms` (ISSUE 7); replica groups map back to the step's
+    `mesh_axis_names`/`mesh_axis_sizes` when the builder attached them.
     """
     lower = getattr(step_fn, "lower", None)
     if lower is None:
@@ -263,6 +277,16 @@ def analyze_step(step_fn, args: Sequence[Any], *,
         except Exception as e:
             report.lint = {"ok": None, "findings": [],
                            "error": repr(e)[:200]}
+    if comms:
+        # same degradation contract as lint: the comms plane is
+        # advisory here — a parser-side surprise must not void the
+        # memory/donation audit that already succeeded
+        try:
+            from apex_tpu.monitor import comms as comms_lib
+            report.comms = comms_lib.comms_report(
+                step_fn, args, compiled=compiled).to_dict()
+        except Exception as e:
+            report.comms = {"ok": None, "error": repr(e)[:200]}
     return report
 
 
@@ -330,4 +354,22 @@ def render_budget_table(report) -> str:
                 f"** LINT: {len(lint.get('findings') or [])} "
                 f"finding(s) [{', '.join(rules)}] — run "
                 "scripts/lint_step.py for the full report")
+    comms = r.get("comms")
+    if comms is not None:
+        if comms.get("collectives") is None:       # analyzer crashed
+            lines.append(f"comms: unavailable "
+                         f"({comms.get('error', '?')[:80]})")
+        else:
+            n = sum((comms.get("counts") or {}).values())
+            total = comms.get("total_comm_bytes", 0)
+            if comms.get("overlap_ok"):
+                verdict = ("overlap ok" if comms.get("async_supported")
+                           else "overlap n/a on this backend")
+            else:
+                n_ser = sum(1 for c in comms["collectives"]
+                            if c.get("serialized"))
+                verdict = f"** {n_ser} SERIALIZED"
+            lines.append(
+                f"comms: {n} collective(s), {_human_bytes(total)} — "
+                f"{verdict} (render_comms_table for the full table)")
     return "\n".join(lines)

@@ -34,7 +34,14 @@ from apex_tpu.monitor.sinks import MetricSink, ScalarWriter
 # `hbm_bytes_limit` (device watermarks; null on backends that don't
 # report) — all OPTIONAL, type-checked by validate_record only when
 # present (OPTIONAL_SCHEMA).
-SCHEMA_VERSION = 3
+# v4 (ISSUE 7): the comms observatory fields — `comms_n_collectives` /
+# `comms_bytes` (inventory totals), `comms_predicted_comm_s` (ICI
+# roofline table price — always computed, table fallback included),
+# `comms_comm_fraction` (null where the backend withholds cost
+# analysis), `comms_overlap_ok` (null when the backend emits no async
+# collectives — CPU) — all OPTIONAL under the same prefix-scalar rule
+# as `hbm_*` (the `comms_` prefix is reserved).
+SCHEMA_VERSION = 4
 
 # field -> (python type, finite_required).  loss_scale may legitimately
 # be large but is finite; grad/update norms are inf/nan ON overflow
@@ -67,8 +74,18 @@ OPTIONAL_SCHEMA = {
     "hbm_bytes_in_use": (int, True),
     "hbm_peak_bytes_in_use": (int, True),
     "hbm_bytes_limit": (int, True),
+    # v4 (ISSUE 7): comms observatory stamps.  A present count/bytes is
+    # a real inventory total (never null) and the predicted comm
+    # seconds is always a table price; fraction and overlap are
+    # null-legal — CPU backends withhold cost analysis (fraction) and
+    # emit no async collectives (overlap).
+    "comms_n_collectives": (int, False),
+    "comms_bytes": (int, False),
+    "comms_predicted_comm_s": (float, True),
+    "comms_comm_fraction": (float, True),
+    "comms_overlap_ok": (bool, True),
 }
-_OPTIONAL_PREFIXES = ("compile_", "hbm_")
+_OPTIONAL_PREFIXES = ("compile_", "hbm_", "comms_")
 
 
 def validate_record(record: dict, prev_step: Optional[int] = None) -> None:
@@ -111,7 +128,10 @@ def validate_record(record: dict, prev_step: Optional[int] = None) -> None:
                 raise ValueError(f"optional field {name!r} is null but "
                                  "must carry a value when present")
             continue
-        if not isinstance(v, typ) or isinstance(v, bool):
+        if typ is float and isinstance(v, int) and not isinstance(v, bool):
+            v = float(v)  # JSON round-trips 0.0 as 0
+        if not isinstance(v, typ) or (typ is not bool
+                                      and isinstance(v, bool)):
             raise ValueError(f"optional field {name!r} is "
                              f"{type(v).__name__}, want {typ.__name__}")
     for k, v in record.items():

@@ -520,6 +520,8 @@ def make_train_step(loss_fn: Callable, optimizer, mesh, *,
             jitted.arg_names = tuple(names)
             jitted.mesh_axis_names = tuple(
                 str(a) for a in mesh.axis_names)
+            jitted.mesh_axis_sizes = tuple(
+                int(s) for s in mesh.devices.shape)
         except AttributeError:  # pragma: no cover
             pass
         return jitted
@@ -553,6 +555,9 @@ def make_train_step(loss_fn: Callable, optimizer, mesh, *,
     step.arg_names = tuple(names)
     # the static linter's collective pass (apex_tpu.lint CL201) checks
     # every traced psum/all_gather axis against the mesh that will run
-    # the program — the builder is the one place both are known
+    # the program — the builder is the one place both are known; the
+    # comms observatory additionally needs the axis SIZES to map
+    # optimized-HLO replica groups back to these names (ISSUE 7)
     step.mesh_axis_names = tuple(str(a) for a in mesh.axis_names)
+    step.mesh_axis_sizes = tuple(int(s) for s in mesh.devices.shape)
     return step

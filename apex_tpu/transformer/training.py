@@ -145,6 +145,8 @@ def make_tp_dp_train_step(model, optimizer, mesh, *,
     step.donate_argnums = (0,) if donate else ()
     step.arg_names = ("opt_state", "tokens", "labels")
     # mesh axes for the static linter's collective-axis check
-    # (apex_tpu.lint CL201) — see parallel/ddp.py
+    # (apex_tpu.lint CL201) and the comms observatory's replica-group
+    # mapping (monitor.comms, ISSUE 7) — see parallel/ddp.py
     step.mesh_axis_names = tuple(str(a) for a in mesh.axis_names)
+    step.mesh_axis_sizes = tuple(int(s) for s in mesh.devices.shape)
     return step

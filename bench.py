@@ -571,10 +571,14 @@ def _compile_audit_350m(on_tpu, batch, seq, cfg, master_dtype):
     # lint=True: the static program passes (apex_tpu.lint, ISSUE 6)
     # run over the same traced step and attach to the report — the
     # JSON's `lint_ok` gate reads them (a flagged flagship program is
-    # a correctness bug, not a perf number)
+    # a correctness bug, not a perf number).  comms=True: the
+    # collective inventory + overlap + ICI roofline (monitor.comms,
+    # ISSUE 7) over the same compiled executable — the JSON's comms_*
+    # stamps read them
     rep = monitor.analyze_step(
         step, (opt_state, tok, tok),
-        analytic_flops=monitor.gpt_step_flops(cfg, batch), lint=True)
+        analytic_flops=monitor.gpt_step_flops(cfg, batch), lint=True,
+        comms=True)
     M.destroy_model_parallel()
     return rep.to_dict()
 
@@ -778,6 +782,35 @@ def main():
                 for f in lint["findings"][:8]]
     except Exception as e:
         result["lint_error"] = repr(e)[:120]
+    # comms observatory stamps (ISSUE 7): flat comms_* scalars from the
+    # flagship audit's attached CommsReport — collective count/bytes,
+    # the roofline's predicted comm seconds + fraction of step, and
+    # the overlap verdict (null where unmeasurable: CPU emits no async
+    # collectives; the prefix-scalar rule of SCHEMA v4 covers these).
+    # Own try, like lint: a stamp-side surprise never voids the audit
+    try:
+        cm = (result.get("compile_audit") or {}).get("comms") or {}
+        if cm.get("collectives") is None and cm.get("error"):
+            result["comms_error"] = cm["error"][:120]
+        elif cm:
+            result["comms_n_collectives"] = int(
+                sum((cm.get("counts") or {}).values()))
+            result["comms_bytes"] = int(cm.get("total_comm_bytes") or 0)
+            result["comms_predicted_comm_s"] = cm.get("predicted_comm_s")
+            result["comms_comm_fraction"] = cm.get("comm_fraction")
+            result["comms_overlap_ok"] = (
+                bool(cm.get("overlap_ok"))
+                if cm.get("async_supported") else None)
+            ser = [c for c in cm.get("collectives", [])
+                   if c.get("serialized")]
+            if ser:
+                # a single string scalar, not a list: the `comms_`
+                # prefix is reserved for JSON scalars by SCHEMA v4
+                result["comms_serialized"] = "; ".join(
+                    f"{c.get('kind')} {c.get('name')} "
+                    f"{c.get('operand_bytes')}B" for c in ser[:8])
+    except Exception as e:
+        result["comms_error"] = repr(e)[:120]
     if _SENTRY:
         result["n_compiles"] = {k: v["n_compiles"]
                                 for k, v in _SENTRY.items()}

@@ -164,7 +164,7 @@ def main():
             recorder.attach_compile_report(monitor.analyze_step(
                 sentry, audit_args,
                 analytic_flops=monitor.gpt_step_flops(cfg, args.batch),
-                lint=True))
+                lint=True, comms=True))
         except Exception as e:  # audit is advisory, never fatal
             print(f"compile audit unavailable: {e!r}")
 

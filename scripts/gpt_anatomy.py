@@ -36,6 +36,9 @@ Usage:
   python scripts/gpt_anatomy.py lint [targets...]          # static lint of the bench
                                                            # steps (trace only; nonzero
                                                            # exit on new findings)
+  python scripts/gpt_anatomy.py comms [targets...]         # collective inventory +
+                                                           # overlap + ICI roofline
+                                                           # (compile only, no execute)
 
 `tune` drives apex_tpu.tune.search over each target's flash shape (and
 the flat-Adam block at the 1B point), writes the winners to the
@@ -470,7 +473,19 @@ def _build_bench_step(t, on_tpu, mode="mem"):
         h, L, H, v = 64, 2, 4, 512
         batch, s = 2, 64
     M.destroy_model_parallel()
-    mesh = M.initialize_model_parallel(devices=jax.devices()[:1])
+    if mode == "comms":
+        # the comms gate is about COLLECTIVES: a single-device mesh
+        # makes every group degenerate (n=1, excluded from the
+        # aggregates), so the overlap gate would be vacuously green.
+        # Mesh over ALL devices (dp = world, like comms_probe's
+        # gpt_zero2 target); the batch must then shard over dp.
+        mesh = M.initialize_model_parallel()
+        dp = mesh.devices.size
+        batch = -(-batch // dp) * dp
+    else:
+        # mem/lint read the single-program truth; one device keeps
+        # the big-config XLA compile affordable
+        mesh = M.initialize_model_parallel(devices=jax.devices()[:1])
     loss_fn = None
     if is_bert:
         # mirror bench._bert_seq_per_sec: BERT-Large MLM+NSP step
@@ -582,6 +597,31 @@ def lint_mode(targets):
     return rc
 
 
+def comms_mode(targets):
+    """Per-target collective inventory + overlap + ICI roofline
+    (ISSUE 7): build the EXACT bench train step, AOT lower+compile it
+    WITHOUT executing, and print the comms table (`monitor.comms`) —
+    what the step says over the interconnect and whether that talk
+    hides behind compute.  Nonzero exit when an expected-overlap
+    collective serialized on a backend where overlap is measurable
+    (TPU); `scripts/comms_probe.py` is the richer CI gate (adds the
+    ZeRO-2 dp target, the allowlist, and --selftest)."""
+    from apex_tpu import monitor
+    from apex_tpu.parallel import mesh as M
+
+    on_tpu = jax.default_backend() not in ("cpu",)
+    rc = 0
+    for t in targets:
+        label, step, args, _ = _build_bench_step(t, on_tpu, mode="comms")
+        print(f"\n--- comms {label} (AOT, no execution)", flush=True)
+        rep = monitor.comms_report(step, args)
+        print(monitor.render_comms_table(rep, label=label), flush=True)
+        if rep.async_supported and not rep.overlap_ok:
+            rc = 1
+        M.destroy_model_parallel()
+    return rc
+
+
 CONFIGS = {
     # name: (hidden, layers, heads, batch, seq, vocab, causal)
     "350m": ("GPT-350M", 1024, 24, 16, 12, 1024, 50304, True),
@@ -627,6 +667,13 @@ if __name__ == "__main__":
             sys.exit(f"unknown lint target(s) {bad}; "
                      f"choices: {sorted(CONFIGS)}")
         sys.exit(lint_mode(targets))
+    elif which == "comms":
+        targets = sys.argv[2:] or ["350m"]
+        bad = [t for t in targets if t not in CONFIGS]
+        if bad:
+            sys.exit(f"unknown comms target(s) {bad}; "
+                     f"choices: {sorted(CONFIGS)}")
+        sys.exit(comms_mode(targets))
     elif which == "blocks":
         flash_block_sweep(causal=False)   # BERT shape
         flash_block_sweep(batch=7, heads=32, seq=512, causal=True)  # 1.3B
@@ -642,4 +689,4 @@ if __name__ == "__main__":
         sys.exit(f"unknown mode {which!r}; expected one of "
                  f"{sorted(CONFIGS)} | both | roofline [target...] | "
                  "blocks | tune [--check] [target...] | mem [target...]"
-                 " | lint [target...]")
+                 " | lint [target...] | comms [target...]")

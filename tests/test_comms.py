@@ -1,0 +1,548 @@
+"""Collective & overlap observatory tests (ISSUE 7): the
+`monitor.comms` inventory on the real ZeRO-2 `ddp.make_train_step`
+(per-bucket reduce-scatters found with correct bytes/dtype/axis on a
+dp=2 CPU mesh), the async start/done overlap classification on a
+seeded serialized-collective HLO fixture, the ICI roofline table
+resolution + override, crash-dump attachment via
+`analyze_step(..., comms=True)`, the SCHEMA v4 `comms_*` record
+fields, the `comms_probe.py --selftest` / fixture gates (tier-1, like
+`lint_step.py --selftest`), and the acceptance line: step numerics
+bitwise identical with the observatory on vs off.
+
+The HLO-text tests need no backend at all; the compiled-step tests run
+tiny programs only — the file must stay cheap (the tier-1 window is a
+dot budget and this file sorts early in the alphabet).
+"""
+
+import json
+import os
+import pathlib
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu import monitor
+from apex_tpu.monitor import comms
+from apex_tpu.monitor import trace
+from apex_tpu.monitor.comms import hlo as hlo_lib
+from apex_tpu.monitor.comms import roofline
+from apex_tpu.parallel import ddp
+from apex_tpu.parallel import mesh as M
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+
+
+# ------------------- seeded HLO fixture (no backend) -------------------
+
+# A hand-written optimized-module dump in XLA's post-scheduling syntax:
+# one async all-reduce whose start->done window holds a dot (hidden),
+# and one async reduce-scatter (spelled via the async-start wrapper
+# form XLA also emits) whose window holds NOTHING — the seeded
+# serialized collective the gate must flag.  Both move 4 MiB over
+# replica group {0,1}.
+_SEEDED_HLO = """\
+HloModule jit_step, is_scheduled=true
+
+%add_f32 (a: f32[], b: f32[]) -> f32[] {
+  %a = f32[] parameter(0)
+  %b = f32[] parameter(1)
+  ROOT %add = f32[] add(f32[] %a, f32[] %b)
+}
+
+%rs_comp (param.1: f32[1048576]) -> f32[524288] {
+  %param.1 = f32[1048576]{0} parameter(0)
+  ROOT %rs = f32[524288]{0} reduce-scatter(f32[1048576]{0} %param.1), replica_groups={{0,1}}, dimensions={0}, to_apply=%add_f32
+}
+
+ENTRY %main (p0: f32[1048576], p1: f32[256,256], p2: f32[256,256]) -> (f32[1048576], f32[524288], f32[256,256]) {
+  %p0 = f32[1048576]{0} parameter(0)
+  %p1 = f32[256,256]{1,0} parameter(1)
+  %p2 = f32[256,256]{1,0} parameter(2)
+  %ar-start = f32[1048576]{0} all-reduce-start(f32[1048576]{0} %p0), replica_groups={{0,1}}, to_apply=%add_f32, metadata={op_name="jit(step)/psum"}
+  %dot.1 = f32[256,256]{1,0} dot(f32[256,256]{1,0} %p1, f32[256,256]{1,0} %p2), lhs_contracting_dims={1}, rhs_contracting_dims={0}
+  %ar-done = f32[1048576]{0} all-reduce-done(f32[1048576]{0} %ar-start)
+  %rs-start = ((f32[1048576]{0}), f32[524288]{0}) async-start(f32[1048576]{0} %p0), calls=%rs_comp
+  %rs-done = f32[524288]{0} async-done(((f32[1048576]{0}), f32[524288]{0}) %rs-start), calls=%rs_comp
+  ROOT %tup = (f32[1048576]{0}, f32[524288]{0}, f32[256,256]{1,0}) tuple(f32[1048576]{0} %ar-done, f32[524288]{0} %rs-done, f32[256,256]{1,0} %dot.1)
+}
+"""
+
+
+def test_seeded_serialized_collective_flagged():
+    """The gate's reason to exist: an async reduce-scatter whose
+    start->done window holds zero dot flops is SERIALIZED; the async
+    all-reduce with a dot inside its window is not."""
+    rep = comms.comms_report(hlo_text=_SEEDED_HLO,
+                             mesh_axis_names=("dp",),
+                             mesh_axis_sizes=(2,),
+                             device_kind="TPU v5e")
+    assert rep.async_supported is True
+    by_kind = {c.kind: c for c in rep.collectives}
+    assert set(by_kind) == {"all-reduce", "reduce-scatter"}
+
+    ar = by_kind["all-reduce"]
+    assert ar.async_pair and ar.operand_bytes == 4 * 2 ** 20
+    assert ar.axes == ("dp",) and ar.group_size == 2
+    assert ar.overlapped_flops == 2.0 * 256 * 256 * 256
+    assert ar.overlap_fraction > 0 and not ar.serialized
+
+    rs = by_kind["reduce-scatter"]
+    assert rs.async_pair and rs.operand_bytes == 4 * 2 ** 20
+    assert rs.output_bytes == 2 * 2 ** 20  # this rank's scattered half
+    assert rs.axes == ("dp",) and rs.group_size == 2
+    assert rs.expected_overlap and rs.overlap_fraction == 0.0
+    assert rs.serialized, "the seeded serialized collective was missed"
+
+    assert rep.overlap_ok is False
+    assert rep.serialized_comm_bytes == 4 * 2 ** 20
+    ser = comms.serialized_collectives(rep)
+    assert [f["name"] for f in ser] == ["rs-start"]
+    text = comms.render_comms_table(rep, label="seeded")
+    assert "**SER**" in text and "SERIALIZED collective(s)" in text
+    # the to_dict form is schema-valid and JSON round-trips
+    d = json.loads(json.dumps(rep.to_dict()))
+    comms.validate_comms_report(d)
+
+
+def test_small_collectives_not_held_to_overlap():
+    """A sub-floor async collective (scalar loss pmean, found_inf OR)
+    is never expected to overlap: noise, not a lever."""
+    tiny = _SEEDED_HLO.replace("1048576", "64").replace("524288", "32")
+    rep = comms.comms_report(hlo_text=tiny, mesh_axis_names=("dp",),
+                             mesh_axis_sizes=(2,))
+    assert all(not c.expected_overlap and not c.serialized
+               for c in rep.collectives)
+    assert rep.overlap_ok is True
+
+
+def test_async_update_chain_pairs_start_done():
+    """XLA may thread start -> async-update -> done; the done's
+    operand then names the UPDATE, not the start.  The pairing must
+    follow the chain — else the window runs to the end of the
+    computation and the gate goes blind to exactly the serialized
+    collective it exists to catch."""
+    old = ("  %rs-done = f32[524288]{0} async-done(((f32[1048576]{0}),"
+           " f32[524288]{0}) %rs-start), calls=%rs_comp\n")
+    new = ("  %rs-upd = ((f32[1048576]{0}), f32[524288]{0}) "
+           "async-update(((f32[1048576]{0}), f32[524288]{0}) "
+           "%rs-start), calls=%rs_comp\n"
+           "  %rs-done = f32[524288]{0} async-done(((f32[1048576]{0}),"
+           " f32[524288]{0}) %rs-upd), calls=%rs_comp\n"
+           # a dot AFTER the done: an unpaired done would stretch the
+           # window over it and launder the serialization as overlap
+           "  %dot.2 = f32[256,256]{1,0} dot(f32[256,256]{1,0} %p1, "
+           "f32[256,256]{1,0} %p2), lhs_contracting_dims={1}, "
+           "rhs_contracting_dims={0}\n")
+    assert old in _SEEDED_HLO  # fixture drift guard for the replace
+    rep = comms.comms_report(hlo_text=_SEEDED_HLO.replace(old, new),
+                             mesh_axis_names=("dp",),
+                             mesh_axis_sizes=(2,))
+    rs = next(c for c in rep.collectives if c.kind == "reduce-scatter")
+    assert rs.async_pair, "done never paired through the update chain"
+    assert rs.serialized and rs.overlap_fraction == 0.0, rs
+    assert rep.overlap_ok is False
+
+
+def test_while_body_collective_inventoried():
+    """A collective inside a while/scan body must not vanish: the loop
+    carry is ONE tuple-typed parameter whose nested parens the
+    computation-header parse must span — a header regex stopping at
+    the first `)` drops every loop body, collectives included, and
+    the probe would pass vacuously green on pipelined/scanned steps."""
+    from jax import shard_map
+    M.destroy_model_parallel()
+    mesh = M.initialize_model_parallel(devices=jax.devices()[:2])
+
+    def run(x):
+        def body(_, c):
+            return jax.lax.psum(c, "dp") * 0.5
+        return jax.lax.fori_loop(0, 3, body, x)
+
+    f = jax.jit(shard_map(run, mesh=mesh, in_specs=(P("dp"),),
+                          out_specs=P("dp"), check_vma=False))
+    rep = comms.comms_report(f, (jnp.ones((2, 8), jnp.float32),),
+                             mesh=mesh)
+    ars = [c for c in rep.collectives if c.kind == "all-reduce"]
+    assert ars, "loop-resident all-reduce vanished from the inventory"
+    assert all(c.axes == ("dp",) and c.group_size == 2 for c in ars)
+    M.destroy_model_parallel()
+
+
+def test_comms_report_compiled_preopt_contradiction():
+    """compiled= carries only the OPTIMIZED module, so asking it for
+    the pre-optimization view must be an error, not a silent
+    optimized-module answer under a pre-opt contract."""
+    with pytest.raises(ValueError, match="optimized=False"):
+        comms.comms_report(None, (), compiled=object(), optimized=False)
+
+
+def test_iota_replica_groups_and_axis_mapping():
+    """The `[G,S]<=[n](T(p))` iota form XLA prints on larger meshes
+    parses to explicit groups, and groups map to the mesh axes whose
+    coordinates vary within a group."""
+    assert hlo_lib._parse_replica_groups(
+        "replica_groups=[2,2]<=[4]") == [[0, 1], [2, 3]]
+    assert hlo_lib._parse_replica_groups(
+        "replica_groups=[2,2]<=[2,2]T(1,0)") == [[0, 2], [1, 3]]
+    # (dp=2, tp=2) mesh: {0,1} varies tp only; {0,2} varies dp only
+    from apex_tpu.monitor.comms.report import _axes_for_groups
+    assert _axes_for_groups([[0, 1], [2, 3]], ("dp", "tp"),
+                            (2, 2)) == ("tp",)
+    assert _axes_for_groups([[0, 2], [1, 3]], ("dp", "tp"),
+                            (2, 2)) == ("dp",)
+    assert _axes_for_groups([[0, 1, 2, 3]], ("dp", "tp"),
+                            (2, 2)) == ("dp", "tp")
+    assert _axes_for_groups([[0]], ("dp",), (2,)) == ()
+    assert _axes_for_groups([[0, 9]], ("dp",), (2,)) is None  # off-mesh
+
+
+# ------------------------------ roofline ------------------------------
+
+def test_ici_table_resolution_and_override():
+    """Sibling contract of flops.DEVICE_BF16_PEAKS: per-generation
+    resolution, v5e fallback for unknown kinds (CPU), override wins."""
+    assert roofline.device_link_bandwidth("TPU v4") == 300e9
+    assert roofline.device_link_bandwidth("TPU v5 lite") == 200e9
+    assert roofline.device_link_bandwidth("TPU v5p") == 600e9
+    assert roofline.device_link_bandwidth("TPU v6 lite") == 448e9
+    assert roofline.device_link_bandwidth("cpu") == \
+        roofline.V5E_ICI_BYTES_PER_S
+    assert roofline.device_link_bandwidth("TPU v4", override=42e9) == 42e9
+
+
+def test_collective_cost_formulas():
+    """The ring-algorithm formulas the predictions are built from."""
+    bw, d = 100e9, 8 * 2 ** 20
+    assert roofline.collective_seconds("all-reduce", d, 4, bw) == \
+        pytest.approx(2 * 0.75 * d / bw)
+    assert roofline.collective_seconds("reduce-scatter", d, 4, bw) == \
+        pytest.approx(0.75 * d / bw)
+    assert roofline.collective_seconds("all-gather", d, 4, bw) == \
+        pytest.approx(3 * d / bw)
+    assert roofline.collective_seconds("collective-permute", d, 4, bw) \
+        == pytest.approx(d / bw)
+    # degenerate groups cost nothing (XLA compiles most of them away)
+    assert roofline.collective_seconds("all-reduce", d, 1, bw) == 0.0
+
+
+def test_report_bandwidth_resolution_per_device_kind():
+    """comms_report prices against the report's device kind (so a
+    saved TPU report re-renders with TPU numbers on any host), and
+    bandwidth_override threads through to the predictions."""
+    r5e = comms.comms_report(hlo_text=_SEEDED_HLO,
+                             device_kind="TPU v5e")
+    assert r5e.link_bandwidth == 200e9
+    assert r5e.bandwidth_source == "table:v5e"
+    r4 = comms.comms_report(hlo_text=_SEEDED_HLO, device_kind="TPU v4")
+    assert r4.link_bandwidth == 300e9
+    assert r4.predicted_comm_s == pytest.approx(
+        r5e.predicted_comm_s * 200 / 300)
+    ovr = comms.comms_report(hlo_text=_SEEDED_HLO, device_kind="TPU v4",
+                             bandwidth_override=50e9)
+    assert ovr.bandwidth_source == "override"
+    assert ovr.link_bandwidth == 50e9
+
+
+def test_rank_timing_crosscheck():
+    """The runtime loop-closer: measured allreduce medians vs the AOT
+    prediction (TIMING_FIELDS column 1 = allreduce_duration_s)."""
+    rep = comms.comms_report(hlo_text=_SEEDED_HLO,
+                             device_kind="TPU v5e")
+    timings = np.array([[1e-3, 2e-3], [1e-3, 4e-3]])  # (ranks, fields)
+    got = comms.crosscheck_rank_timing(rep, timings)
+    assert got["measured_s"] == pytest.approx(3e-3)
+    assert got["n_ranks"] == 2
+    assert got["ratio"] == pytest.approx(
+        3e-3 / rep.predicted_comm_s)
+
+
+# --------------------- the real ZeRO-2 train step ---------------------
+
+def _zero2_linear_step(mesh, n_buckets=2):
+    """The real `ddp.make_train_step` ZeRO-2 path (DistributedFusedAdam
+    auto-detected, per-bucket psum_scatter) on a dp=2 slice of the CPU
+    mesh — the miniature of the flagship gpt_zero2 gate target."""
+    from jax import shard_map
+
+    from apex_tpu.optimizers.distributed_fused_adam import (
+        DistributedFusedAdam,
+    )
+
+    rng = np.random.default_rng(3)
+    X = jnp.asarray(rng.normal(size=(8, 16)), jnp.float32)
+    Y = jnp.asarray(rng.normal(size=(8, 1)), jnp.float32)
+    params = {"w1": jnp.zeros((16, 64)), "w2": jnp.zeros((64, 1))}
+
+    def loss_fn(p, b):
+        x, y = b
+        return jnp.mean((jnp.tanh(x @ p["w1"]) @ p["w2"] - y) ** 2)
+
+    opt = DistributedFusedAdam(num_shards=2, lr=1e-2, use_pallas=False,
+                               n_buckets=n_buckets)
+    sspec = opt.state_partition_specs()
+    state = jax.jit(shard_map(opt.init, mesh=mesh, in_specs=(P(),),
+                              out_specs=sspec, check_vma=False))(params)
+    step = ddp.make_train_step(loss_fn, opt, mesh,
+                               batch_spec=(P("dp"), P("dp")))
+    return step, state, (X, Y)
+
+
+def test_zero2_step_inventory_dp2():
+    """Acceptance: the inventory on the real ZeRO-2 step finds the
+    per-bucket reduce-scatters with correct bytes/dtype/axis, mapped
+    through the builder-attached mesh metadata (no mesh= passed)."""
+    M.destroy_model_parallel()
+    mesh = M.initialize_model_parallel(devices=jax.devices()[:2])
+    step, state, batch = _zero2_linear_step(mesh, n_buckets=2)
+    assert step.mesh_axis_names == ("pp", "dp", "tp")
+    assert step.mesh_axis_sizes == (1, 2, 1)
+    rep = comms.comms_report(step, (state, None, batch))
+    assert rep.mesh_axis_names == ("pp", "dp", "tp")
+
+    rs = [c for c in rep.collectives if c.kind == "reduce-scatter"]
+    assert len(rs) >= 2, f"per-bucket reduce-scatters not found: {rep}"
+    for c in rs:
+        assert c.axes == ("dp",), c
+        assert c.group_size == 2 and c.dtype == "f32", c
+    # the buckets partition the padded flat grad buffer: operand
+    # bytes sum to the full (unscattered) master-length buffer
+    full_elems = int(state.params_shard.shape[0])
+    assert sum(c.operand_bytes for c in rs) == full_elems * 4
+    # ZeRO-2 tail: the updated param shards all-gather back, same axis
+    ags = [c for c in rep.collectives if c.kind == "all-gather"]
+    assert ags and all(c.axes == ("dp",) for c in ags)
+    # aggregates count the dp collectives only (degenerate excluded)
+    assert rep.counts.get("reduce-scatter") == len(rs)
+    assert rep.total_comm_bytes == sum(
+        c.operand_bytes for c in rep.collectives if c.group_size > 1)
+    # CPU backend: sync collectives only — measured as unmeasurable
+    assert rep.async_supported is False
+    assert rep.overlap_ok is True
+    assert all(c.overlap_fraction is None for c in rep.collectives)
+    M.destroy_model_parallel()
+
+
+def test_zero2_numerics_bitwise_identical_with_observatory():
+    """Acceptance: training is bitwise identical whether or not the
+    comms observatory (comms_report + analyze_step(comms=True)) ran
+    against the step."""
+    M.destroy_model_parallel()
+    mesh = M.initialize_model_parallel(devices=jax.devices()[:2])
+    plain, s_plain, batch = _zero2_linear_step(mesh)
+    for _ in range(3):
+        s_plain, _, _ = plain(s_plain, None, batch)
+
+    audited, s_aud, _ = _zero2_linear_step(mesh)
+    rep = comms.comms_report(audited, (s_aud, None, batch))
+    assert rep.counts  # the audit actually saw the program
+    full = monitor.analyze_step(audited, (s_aud, None, batch),
+                                comms=True)
+    assert full.comms is not None
+    for _ in range(3):
+        s_aud, _, _ = audited(s_aud, None, batch)
+    a = np.asarray(jax.device_get(s_plain.params_shard))
+    b = np.asarray(jax.device_get(s_aud.params_shard))
+    assert a.tobytes() == b.tobytes(), "comms observatory changed bits"
+    M.destroy_model_parallel()
+
+
+def test_preopt_inventory_keeps_authored_dtype():
+    """optimized=False reads the pre-optimization module: CPU XLA's
+    float-normalization rewrites bf16 collectives to f32 in the
+    OPTIMIZED module (backend artifact — TPU keeps bf16), so authored-
+    dtype claims (the ported test_distributed_optimizers probes) must
+    look pre-opt."""
+    from jax import shard_map
+    M.destroy_model_parallel()
+    mesh = M.initialize_model_parallel(devices=jax.devices()[:2])
+    f = jax.jit(shard_map(
+        lambda x: jax.lax.all_gather(x, "dp", tiled=True), mesh=mesh,
+        in_specs=(P("dp"),), out_specs=P(), check_vma=False))
+    x = jnp.ones((8, 4), jnp.bfloat16)
+    pre = comms.comms_report(f, (x,), mesh=mesh, optimized=False)
+    (ag,) = [c for c in pre.collectives if c.kind == "all-gather"]
+    assert ag.dtype == "bf16" and ag.axes == ("dp",)
+    assert ag.operand_bytes == 4 * 4 * 2  # this rank's bf16 shard
+    opt = comms.comms_report(f, (x,), mesh=mesh)
+    (ag_o,) = [c for c in opt.collectives if c.kind == "all-gather"]
+    assert ag_o.dtype == "f32"  # the CPU normalization artifact
+    M.destroy_model_parallel()
+
+
+def test_collective_only_program_is_comm_bound():
+    """cost_analysis flops == 0.0 is a real answer (a program that only
+    talks is 100% comm-bound), not a missing cost analysis — the falsy
+    check `if xla_flops:` used to drop the verdict entirely."""
+    from jax import shard_map
+    M.destroy_model_parallel()
+    mesh = M.initialize_model_parallel(devices=jax.devices()[:2])
+    f = jax.jit(shard_map(lambda x: jax.lax.psum(x, "dp"), mesh=mesh,
+                          in_specs=(P("dp"),), out_specs=P(),
+                          check_vma=False))
+    x = jnp.ones((2, 1024), jnp.float32)
+    rep = comms.comms_report(f, (x,), mesh=mesh)
+    assert rep.counts.get("all-reduce", 0) >= 1
+    assert rep.compute_s is not None  # flops=0.0 kept, not dropped
+    assert rep.comm_fraction is not None and rep.comm_fraction > 0.99
+    assert rep.comm_bound is True
+    assert "COMM-BOUND" in comms.render_comms_table(
+        rep.to_dict(), label="psum-only")
+    M.destroy_model_parallel()
+
+
+# ------------------- attachment, schema, rendering -------------------
+
+def test_analyze_step_attaches_comms_and_crash_dump_carries_it(tmp_path):
+    """analyze_step(..., comms=True) reuses the SAME executable, the
+    report rides the flight-recorder crash dump with no recorder
+    schema change, and render_budget_table prints the verdict line."""
+    f = jax.jit(lambda a, b: (a @ b).sum())
+    a = jax.ShapeDtypeStruct((32, 32), jnp.float32)
+    rep = monitor.analyze_step(f, (a, a), comms=True)
+    assert rep.comms is not None
+    comms.validate_comms_report(rep.comms)
+    assert "comms:" in monitor.render_budget_table(rep)
+    # comms=False (default) carries None and renders without the line
+    assert monitor.analyze_step(f, (a, a)).comms is None
+
+    path = tmp_path / "flight.json"
+    rec = trace.FlightRecorder(path, capacity=4)
+    rec.attach_compile_report(rep)
+    with pytest.raises(RuntimeError):
+        with rec.guard():
+            raise RuntimeError("boom")
+    data = json.loads(path.read_text())
+    trace.validate_report(data)
+    comms.validate_comms_report(data["compile_report"]["comms"])
+
+
+def test_validate_record_v4_comms_fields_roundtrip(tmp_path):
+    """SCHEMA_VERSION 3->4: the comms_* optional fields are null-legal
+    exactly where the backend withholds the plane (roofline/overlap),
+    never for the inventory totals, and survive a JSONLSink round
+    trip under the prefix-scalar rule."""
+    assert monitor.SCHEMA_VERSION == 4
+    base = {"monitor_schema_version": monitor.SCHEMA_VERSION, "step": 1,
+            "loss": 1.0, "grad_norm": 0.1, "param_norm": 1.0,
+            "update_norm": 0.0, "loss_scale": 1.0, "overflow_count": 0,
+            "skipped_steps": 0, "tokens_seen": 0.0, "step_time_ms": 1.0,
+            "tokens_per_sec": 1.0, "mfu": 0.0}
+    good = dict(base, comms_n_collectives=8, comms_bytes=3 * 2 ** 20,
+                comms_predicted_comm_s=1.5e-4, comms_comm_fraction=0.25,
+                comms_overlap_ok=True)
+    monitor.validate_record(good)
+    # null-legal: the CPU stamps (no cost analysis, no async plane)
+    monitor.validate_record(dict(base, comms_comm_fraction=None,
+                                 comms_overlap_ok=None,
+                                 comms_predicted_comm_s=None))
+    # the inventory totals must carry a value when present
+    with pytest.raises(ValueError, match="comms_n_collectives"):
+        monitor.validate_record(dict(base, comms_n_collectives=None))
+    with pytest.raises(ValueError, match="comms_bytes"):
+        monitor.validate_record(dict(base, comms_bytes=1.5))
+    # prefix-scalar rule: unknown comms_ keys must be JSON scalars
+    monitor.validate_record(dict(base, comms_custom="ok"))
+    with pytest.raises(ValueError, match="scalar"):
+        monitor.validate_record(dict(base, comms_custom={"no": 1}))
+    # JSON round trip (0.25 stays float, ints stay ints)
+    monitor.validate_record(json.loads(json.dumps(good)))
+
+
+def test_allowlist_parse_and_apply():
+    """lint_allowlist-style `KIND location-glob` lines; the committed
+    file starts EMPTY."""
+    entries = comms.parse_allowlist(
+        "# comment\n"
+        "reduce-scatter gpt_zero2:rs-start*  # deliberate\n"
+        "all-gather *\n")
+    assert entries == [("reduce-scatter", "gpt_zero2:rs-start*"),
+                       ("all-gather", "*")]
+    with pytest.raises(ValueError, match="unknown collective kind"):
+        comms.parse_allowlist("psum foo")
+    findings = [{"kind": "reduce-scatter", "name": "rs-start.1"},
+                {"kind": "reduce-scatter", "name": "other"}]
+    new, allowed = comms.apply_allowlist(findings, entries, "gpt_zero2")
+    assert [f["name"] for f in allowed] == ["rs-start.1"]
+    assert [f["name"] for f in new] == ["other"]
+    # the committed allowlist is empty
+    committed = (ROOT / "scripts" / "comms_allowlist.txt").read_text()
+    assert comms.parse_allowlist(committed) == []
+
+
+def test_comms_schema_drift_detected():
+    """validate_comms_report fails loudly on version or field drift —
+    what --selftest turns into a CI exit code."""
+    rep = comms.comms_report(hlo_text=_SEEDED_HLO).to_dict()
+    comms.validate_comms_report(rep)
+    with pytest.raises(ValueError, match="comms_schema_version"):
+        comms.validate_comms_report(dict(rep, comms_schema_version=99))
+    with pytest.raises(ValueError, match="overlap_ok"):
+        comms.validate_comms_report(
+            {k: v for k, v in rep.items() if k != "overlap_ok"})
+    broken = json.loads(json.dumps(rep))
+    broken["collectives"][0]["kind"] = "psum"
+    with pytest.raises(ValueError, match="unknown kind"):
+        comms.validate_comms_report(broken)
+
+
+# ----------------------------- CLI gates -----------------------------
+
+def _run_script(path, *args, timeout=600):
+    return subprocess.run(
+        [sys.executable, str(path), *args], capture_output=True,
+        text=True, timeout=timeout,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+
+
+def test_comms_probe_selftest():
+    """Tier-1 CI gate (mirrors lint_step.py --selftest): the committed
+    fixture validates, renders with its load-bearing markers, and its
+    seeded serialized collective is still flagged."""
+    r = _run_script(ROOT / "scripts" / "comms_probe.py", "--selftest")
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "comms_probe --selftest: OK" in r.stdout
+
+
+def test_comms_probe_cli_flagships_clean():
+    """Acceptance: `scripts/comms_probe.py` exits 0 on the flagship
+    steps (ZeRO-2 dp step + GPT smoke) with the EMPTY committed
+    allowlist, and its inventory finds the per-bucket
+    reduce-scatters."""
+    r = _run_script(ROOT / "scripts" / "comms_probe.py", "--json")
+    assert r.returncode == 0, r.stdout + r.stderr
+    reports = [json.loads(line) for line in r.stdout.splitlines()
+               if line.startswith("{")]
+    zero2 = next(x for x in reports if x["target"] == "gpt_zero2")
+    rs = [c for c in zero2["report"]["collectives"]
+          if c["kind"] == "reduce-scatter"]
+    assert len(rs) >= 4 and all(c["axes"] == ["dp"] for c in rs)
+
+
+def test_comms_probe_gates_serialized_report():
+    """Acceptance: --report on the committed fixture (which seeds a
+    serialized reduce-scatter) exits NONZERO — the gate's negative
+    control — and the allowlist path accepts it back."""
+    fixture = ROOT / "scripts" / "comms_fixture.json"
+    r = _run_script(ROOT / "scripts" / "comms_probe.py",
+                    "--report", str(fixture))
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "FAIL" in r.stdout and "serialized" in r.stdout
+    # an allowlist naming the seeded collective turns the gate green
+    import tempfile
+    with tempfile.NamedTemporaryFile("w", suffix=".txt",
+                                     delete=False) as f:
+        f.write("reduce-scatter *reduce-scatter-start*\n")
+        allowpath = f.name
+    try:
+        r2 = _run_script(ROOT / "scripts" / "comms_probe.py",
+                         "--report", str(fixture),
+                         "--allowlist", allowpath)
+        assert r2.returncode == 0, r2.stdout + r2.stderr
+        assert "allowlisted" in r2.stdout
+    finally:
+        os.unlink(allowpath)

@@ -103,9 +103,11 @@ def test_pipelined_grads_flow():
 
 def _pipeline_allreduce_sizes(with_loss_fn):
     """Lower spmd_pipeline over a pp-only mesh with a toy stage and
-    return every all-reduce operand size in the optimized HLO."""
-    import re
-
+    return every float all-reduce's operand element count from the
+    monitor.comms inventory of the optimized HLO (ISSUE 7 port of the
+    hand-rolled shape-regex; the inventory also pins each all-reduce
+    to the pp axis, which the regex could not see)."""
+    from apex_tpu.monitor import comms
     from apex_tpu.transformer.pipeline_parallel.schedules import (
         spmd_pipeline)
     M.destroy_model_parallel()
@@ -127,20 +129,14 @@ def _pipeline_allreduce_sizes(with_loss_fn):
 
     f = jax.jit(shard_map(run, mesh=mesh, in_specs=(P(), P()),
                           out_specs=P(), check_vma=False))
-    hlo = f.lower(w, mbs).compile().as_text()
+    rep = comms.comms_report(f, (w, mbs), mesh=mesh)
     M.destroy_model_parallel()
     sizes = []
-    for line in hlo.splitlines():
-        if "all-reduce" not in line:
+    for c in rep.collectives:
+        if c.kind != "all-reduce" or c.dtype not in ("f32", "f16"):
             continue
-        shp = re.search(r"f(?:32|16)\[([\d,]*)\]", line)
-        if shp is None:
-            continue
-        dims = [int(d) for d in shp.group(1).split(",") if d]
-        n = 1
-        for d in dims:
-            n *= d
-        sizes.append(n)
+        assert c.axes in (("pp",), ()), c  # a pp-only mesh
+        sizes.append(c.operand_bytes // (4 if c.dtype == "f32" else 2))
     return sizes
 
 
