@@ -14,10 +14,15 @@ from __future__ import annotations
 
 import os
 import pickle
+import warnings
 from typing import Any, Optional
 
 import jax
 import numpy as np
+
+_ORBAX_HINT = ("orbax is not installed — install the checkpoint extra "
+               "(`pip install orbax-checkpoint`) for sharded "
+               "checkpoints")
 
 
 def _to_host(tree):
@@ -26,7 +31,15 @@ def _to_host(tree):
 
 def save_checkpoint(path: str, tree, step: Optional[int] = None,
                     use_orbax: bool = True) -> str:
-    """Save a pytree; returns the directory written."""
+    """Save a pytree; returns the directory written.
+
+    With orbax available the state is written through
+    `PyTreeCheckpointer`; a MISSING orbax degrades to the pickle
+    fallback with a one-time warning naming the extra (it used to
+    degrade silently — an operator who thought they had sharded
+    checkpoints found out at restore time).  A real orbax save error
+    (disk full, bad tree) raises — it must not be laundered into a
+    silent format downgrade."""
     path = os.path.abspath(path)
     if step is not None:
         path = os.path.join(path, f"step_{step}")
@@ -34,12 +47,15 @@ def save_checkpoint(path: str, tree, step: Optional[int] = None,
     if use_orbax:
         try:
             import orbax.checkpoint as ocp
+        except ImportError:
+            warnings.warn(
+                f"save_checkpoint: {_ORBAX_HINT}; falling back to the "
+                "single-file pickle format", stacklevel=2)
+        else:
             ckpt = ocp.PyTreeCheckpointer()
             ckpt.save(os.path.join(path, "state"), _to_host(tree),
                       force=True)
             return path
-        except Exception:
-            pass
     with open(os.path.join(path, "state.pkl"), "wb") as f:
         pickle.dump(_to_host(tree), f)
     return path
@@ -47,13 +63,23 @@ def save_checkpoint(path: str, tree, step: Optional[int] = None,
 
 def load_checkpoint(path: str, step: Optional[int] = None,
                     target: Any = None):
-    """Restore a pytree saved by save_checkpoint."""
+    """Restore a pytree saved by save_checkpoint.
+
+    A checkpoint written in the orbax layout NEEDS orbax to read —
+    there is no pickle to fall back to, so a missing install raises an
+    ImportError that names the extra instead of the bare module-level
+    one."""
     path = os.path.abspath(path)
     if step is not None:
         path = os.path.join(path, f"step_{step}")
     orbax_path = os.path.join(path, "state")
     if os.path.exists(orbax_path):
-        import orbax.checkpoint as ocp
+        try:
+            import orbax.checkpoint as ocp
+        except ImportError as e:
+            raise ImportError(
+                f"load_checkpoint: {orbax_path} is an orbax-format "
+                f"checkpoint but {_ORBAX_HINT}") from e
         ckpt = ocp.PyTreeCheckpointer()
         restored = ckpt.restore(orbax_path)
         if target is not None:

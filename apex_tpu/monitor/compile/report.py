@@ -121,9 +121,15 @@ def _classify_budget(args: Sequence[Any], names: Sequence[str]) -> dict:
     operator reasons in.  Convention (the `make_train_step` arg order):
     an arg named `opt_state` with NamedTuple fields contributes its
     master buffer (`params`/`params_shard` fields) to "params" and the
-    rest (moments, step counter) to "optimizer_state"; every other arg
-    counts as "inputs" (batch, scaler, metrics pytree, timing rows)."""
-    params = opt_state = inputs = 0
+    rest (moments, step counter) to "optimizer_state"; an arg whose
+    name contains `kv_cache` or `page` is the serving path's paged KV
+    pool (ISSUE 8 — the thing a serve report must price separately
+    from weights: its size scales with CONCURRENT USERS, not model
+    size); an arg named `params` is a bare weight pytree (the serve
+    decode step passes weights without an optimizer wrapper); every
+    other arg counts as "inputs" (batch, scaler, metrics pytree,
+    timing rows)."""
+    params = opt_state = inputs = kv_cache = 0
     for name, arg in zip(names, args):
         if name == "opt_state" and hasattr(arg, "_fields"):
             for field in arg._fields:
@@ -132,10 +138,14 @@ def _classify_budget(args: Sequence[Any], names: Sequence[str]) -> dict:
                     params += b
                 else:
                     opt_state += b
+        elif "kv_cache" in name or "page" in name:
+            kv_cache += tree_bytes(arg)
+        elif name == "params":
+            params += tree_bytes(arg)
         else:
             inputs += tree_bytes(arg)
     return {"params": params, "optimizer_state": opt_state,
-            "inputs": inputs}
+            "inputs": inputs, "kv_cache": kv_cache}
 
 
 def _cost_entry(compiled) -> Optional[dict]:
@@ -314,10 +324,13 @@ def render_budget_table(report) -> str:
     ]
     for key, label in (("params", "params (master)"),
                        ("optimizer_state", "optimizer state"),
+                       ("kv_cache", "kv cache (pages)"),
                        ("inputs", "inputs (batch etc.)"),
                        ("activations_temps", "activations + temps"),
                        ("outputs", "outputs"),
                        ("generated_code", "generated code")):
+        if key == "kv_cache" and not budget.get(key):
+            continue          # training steps have no pool; keep tables tidy
         lines.append(f"| {label:<19} | "
                      f"{_human_bytes(budget.get(key)):>10} |")
     alias = r.get("alias_bytes")

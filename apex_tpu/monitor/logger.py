@@ -41,7 +41,18 @@ from apex_tpu.monitor.sinks import MetricSink, ScalarWriter
 # analysis), `comms_overlap_ok` (null when the backend emits no async
 # collectives — CPU) — all OPTIONAL under the same prefix-scalar rule
 # as `hbm_*` (the `comms_` prefix is reserved).
-SCHEMA_VERSION = 4
+# v5 (ISSUE 8): the serving fields — `serve_streams` (concurrency of
+# the stamped measurement), `serve_decode_tokens_per_sec` (continuous-
+# batching decode throughput over tokens ACTUALLY emitted),
+# `serve_p50_ms` / `serve_p99_ms` (per-token latency percentiles over
+# pure decode steps — admission/retirement churn steps carry prefill
+# work and are excluded), `serve_recompile_ok`
+# (the RecompileSentry verdict over the decode step: False means the
+# scheduler retraced under churn, the correctness gate of
+# apex_tpu.serve) — all OPTIONAL, never-null when present (a serve
+# measurement that ran has all five), `serve_` prefix reserved for
+# JSON scalars like `comms_`.
+SCHEMA_VERSION = 5
 
 # field -> (python type, finite_required).  loss_scale may legitimately
 # be large but is finite; grad/update norms are inf/nan ON overflow
@@ -84,8 +95,17 @@ OPTIONAL_SCHEMA = {
     "comms_predicted_comm_s": (float, True),
     "comms_comm_fraction": (float, True),
     "comms_overlap_ok": (bool, True),
+    # v5 (ISSUE 8): serving stamps.  A serve measurement that ran
+    # carries real values for all of these (no null-legal fields — on
+    # a backend where serving can't run, bench simply doesn't stamp
+    # them, per the try/except-per-metric convention).
+    "serve_streams": (int, False),
+    "serve_decode_tokens_per_sec": (float, False),
+    "serve_p50_ms": (float, False),
+    "serve_p99_ms": (float, False),
+    "serve_recompile_ok": (bool, False),
 }
-_OPTIONAL_PREFIXES = ("compile_", "hbm_", "comms_")
+_OPTIONAL_PREFIXES = ("compile_", "hbm_", "comms_", "serve_")
 
 
 def validate_record(record: dict, prev_step: Optional[int] = None) -> None:

@@ -1,0 +1,42 @@
+"""apex_tpu.serve — the inference subsystem (ISSUE 8 tentpole).
+
+Three layers, bottom-up:
+
+  * ops/flash_decode.py — single/few-query flash attention against a
+    PAGED KV cache: the kernel gathers pages through a per-slot block
+    table at DMA time (scalar-prefetch index map), so the compiled
+    shapes never depend on sequence length or concurrency.
+  * serve/kv_cache.py — the page pool + block-table allocator:
+    thousands of ragged sequences share one fixed pool of HBM pages;
+    partial pages and stale table entries are masked BY POSITION,
+    never cleaned.
+  * serve/engine.py — continuous batching: a host-side scheduler that
+    admits and retires requests into a fixed slot grid every step;
+    per-slot state lives on device, the decode step is sync-free, and
+    a RecompileSentry enforces that steady-state churn never
+    retraces.
+
+docs/serving.md is the operator guide; examples/serve_gpt.py the
+runnable entry point; bench.py stamps `serve_*` decode-throughput and
+latency axes.
+"""
+
+from apex_tpu.ops.flash_decode import (  # noqa: F401
+    flash_decode,
+    paged_attention_reference,
+)
+from apex_tpu.serve.engine import (  # noqa: F401
+    DecodeEngine,
+    DecodeState,
+    FinishedRequest,
+    ServeConfig,
+    build_flagship_engine,
+    measure_decode,
+)
+from apex_tpu.serve.kv_cache import (  # noqa: F401
+    TRASH_PAGE,
+    KVCacheConfig,
+    PagedKVCache,
+    default_page_size,
+    gather_slot,
+)

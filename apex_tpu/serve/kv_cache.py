@@ -1,0 +1,244 @@
+"""Paged KV cache — a fixed pool of HBM pages shared by every live
+sequence (ISSUE 8 tentpole, layer 2).
+
+The serving memory problem: a dense per-slot cache is
+``(n_slots, max_model_len)`` whether a user sent 10 tokens or 10k —
+worst-case HBM is pinned per CONCURRENT USER, which caps concurrency
+at the longest request anyone might send.  Paging breaks that link:
+the cache is one pool of ``(page_size, head_dim)`` pages, a sequence
+owns only the pages its actual length needs, and a per-slot BLOCK
+TABLE names which pool pages hold its tokens (the vLLM design,
+re-aimed at XLA's static-shape constraint).
+
+Static-shape contract (the "why shapes never change" half that lives
+here; serve/engine.py holds the scheduler half):
+
+  * the pool arrays ``k_pages``/``v_pages`` are allocated ONCE at
+    engine construction — ``(n_layers, n_kv_heads, n_pages,
+    page_size, head_dim)`` — and never reshaped;
+  * the block table is ``(n_slots, pages_per_slot_max)`` int32 and
+    never reshaped; admission/retirement edit VALUES only;
+  * page 0 is the TRASH page: it is never allocated to a sequence,
+    and every masked-out write (inactive slots, prompt padding) is
+    routed to it so the scatter that writes new K/V needs no dynamic
+    shape or host branch;
+  * stale table entries and partial last pages are masked BY POSITION
+    in the decode kernel (ops/flash_decode.py), never by data — a
+    recycled page needs no cleaning between requests.
+
+Allocation is HOST-side (a free list of page ids) and happens only at
+admission/retirement — never inside the jitted decode step, which
+sees the table as a plain int32 argument.  Pages for a request are
+reserved at admission for its worst case (prompt + max_new_tokens),
+so the step can run to completion without the device ever asking the
+host for memory; the saving vs a dense cache is that the reservation
+is per-REQUEST worst case, not per-SLOT model-length worst case.
+
+``page_size`` is owned by the apex_tpu.tune cache (op ``serve_page``,
+key ``tune.serve_page_attrs``) with a deterministic heuristic
+fallback, because the page is the decode kernel's kv block: one page
+= one DMA per grid step, so the same knob sets the gather granularity
+and the pool's internal fragmentation (≤ page_size - 1 tokens wasted
+per sequence).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# the reserved trash page (module contract above)
+TRASH_PAGE = 0
+
+
+def default_page_size(n_kv_heads: int, head_dim: int, dtype=None) -> int:
+    """Tuner-owned page size with a deterministic heuristic fallback.
+
+    Consults ``tune.tuned("serve_page", ...)`` for this cache layout on
+    this device kind; a miss (or a nonsense cached value) falls back to
+    the heuristic: 128 — the TPU lane width, so the per-page score tile
+    of the decode kernel fills whole vregs, and at d=64/bf16 a page is
+    16 KiB per kv head, a comfortable DMA unit.  Pure host-side lookup,
+    safe at trace time (tune package docstring)."""
+    try:
+        from apex_tpu import tune
+        cfg = tune.tuned("serve_page",
+                         tune.serve_page_attrs(n_kv_heads, head_dim,
+                                               dtype))
+    except Exception:  # pragma: no cover — tune must never break serve
+        cfg = None
+    if cfg:
+        ps = cfg.get("page_size")
+        if isinstance(ps, int) and 8 <= ps <= 2048 and ps % 8 == 0:
+            return ps
+    return 128
+
+
+@dataclasses.dataclass(frozen=True)
+class KVCacheConfig:
+    """Static layout of the paged pool.
+
+    page_size None consults the autotuner (``serve_page``) and falls
+    back to the 128-lane heuristic — an empty cache is deterministic.
+    n_pages includes the trash page; ``usable_pages`` is what requests
+    can actually own.  pages_per_slot_max bounds one sequence's table
+    row (its max length is pages_per_slot_max * page_size)."""
+
+    n_layers: int
+    n_kv_heads: int
+    head_dim: int
+    n_slots: int
+    n_pages: int
+    pages_per_slot_max: int
+    page_size: Optional[int] = None
+    dtype: Any = jnp.bfloat16
+
+    def __post_init__(self):
+        if self.page_size is None:
+            object.__setattr__(
+                self, "page_size",
+                default_page_size(self.n_kv_heads, self.head_dim,
+                                  self.dtype))
+        if self.n_pages < 2:
+            raise ValueError(
+                f"n_pages={self.n_pages}: need at least the trash page "
+                "+ one usable page")
+        if self.n_slots < 1 or self.pages_per_slot_max < 1:
+            raise ValueError("n_slots and pages_per_slot_max must be >= 1")
+
+    @property
+    def usable_pages(self) -> int:
+        return self.n_pages - 1          # page 0 is the trash page
+
+    @property
+    def max_seq_len(self) -> int:
+        """Longest sequence one table row can address."""
+        return self.pages_per_slot_max * self.page_size
+
+    def pages_for(self, n_tokens: int) -> int:
+        """Pages a sequence of n_tokens tokens occupies."""
+        return -(-max(0, int(n_tokens)) // self.page_size)
+
+    # ------------------------------ pricing ------------------------------
+    # the numbers `analyze_step`'s budget table and docs/serving.md
+    # quote: what the pool costs, and what one concurrent user costs
+
+    def page_bytes(self) -> int:
+        """HBM bytes of ONE page across all layers, K and V."""
+        return (2 * self.n_layers * self.n_kv_heads * self.page_size
+                * self.head_dim * jnp.dtype(self.dtype).itemsize)
+
+    def pool_bytes(self) -> int:
+        """Total HBM of the page pool (the `kv_cache` budget row)."""
+        return self.n_pages * self.page_bytes()
+
+    def bytes_per_token(self) -> int:
+        """Cache bytes one token costs (all layers, K+V)."""
+        return (2 * self.n_layers * self.n_kv_heads * self.head_dim
+                * jnp.dtype(self.dtype).itemsize)
+
+    def bytes_per_user(self, seq_len: int) -> int:
+        """Cache bytes one concurrent user at seq_len costs — page
+        granularity included (the partial last page is paid in full)."""
+        return self.pages_for(seq_len) * self.page_bytes()
+
+
+class PagedKVCache:
+    """The pool + the host-side free-list allocator.
+
+    Device side: ``k_pages``/``v_pages`` jnp arrays in the kernel's
+    layout and a ``block_table`` int32 array (all static shapes —
+    module contract).  The ENGINE owns the device arrays once decoding
+    starts (they ride inside its donated state); this object keeps the
+    authoritative host mirror of the table and the free list, and
+    hands out fresh device tables after admission edits.
+
+    Host side: ``allocate(n)`` pops page ids from the free list (None
+    when the pool can't serve n — the scheduler's admission-control
+    signal), ``release(ids)`` returns them.  Page 0 (TRASH_PAGE) is
+    never handed out.
+    """
+
+    def __init__(self, config: KVCacheConfig):
+        self.config = config
+        c = config
+        self._free: List[int] = list(range(1, c.n_pages))
+        # host mirror of the block table; unassigned entries point at
+        # the trash page (read-harmless: masked by position)
+        self._table = np.full((c.n_slots, c.pages_per_slot_max),
+                              TRASH_PAGE, np.int32)
+        self._slot_pages: Dict[int, List[int]] = {}
+
+    # ------------------------- device arrays -------------------------
+
+    def init_pages(self):
+        """Fresh zeroed (k_pages, v_pages) pool arrays in the decode
+        kernel's layout.  Zeros are a convenience, not a correctness
+        requirement — the position masking contract means garbage
+        would serve equally."""
+        c = self.config
+        shape = (c.n_layers, c.n_kv_heads, c.n_pages, c.page_size,
+                 c.head_dim)
+        return jnp.zeros(shape, c.dtype), jnp.zeros(shape, c.dtype)
+
+    def device_table(self):
+        """The current block table as a device array (push after
+        admission edits; shape never changes)."""
+        return jnp.asarray(self._table)
+
+    # ------------------------- allocation ----------------------------
+
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    def can_admit(self, n_tokens: int) -> bool:
+        n = self.config.pages_for(n_tokens)
+        return n <= len(self._free) and n <= self.config.pages_per_slot_max
+
+    def allocate_slot(self, slot: int, n_tokens: int) -> Optional[np.ndarray]:
+        """Reserve pages for a sequence of up to n_tokens tokens in
+        `slot` and point the slot's table row at them.  Returns the
+        row (int32, pages_per_slot_max) or None when the pool or the
+        table row cannot serve it — the caller queues the request."""
+        c = self.config
+        if slot in self._slot_pages:
+            raise ValueError(f"slot {slot} already holds pages; "
+                             "release_slot first")
+        n = c.pages_for(n_tokens)
+        if n > c.pages_per_slot_max or n > len(self._free):
+            return None
+        pages = [self._free.pop() for _ in range(n)]
+        self._slot_pages[slot] = pages
+        row = np.full((c.pages_per_slot_max,), TRASH_PAGE, np.int32)
+        row[:n] = pages
+        self._table[slot] = row
+        return row
+
+    def release_slot(self, slot: int) -> None:
+        """Return a retired slot's pages to the pool.  The table row
+        keeps its (now stale) entries until reassignment — stale ids
+        are read-harmless by the position-masking contract."""
+        pages = self._slot_pages.pop(slot, None)
+        if pages:
+            self._free.extend(pages)
+
+    def slot_pages(self, slot: int) -> List[int]:
+        return list(self._slot_pages.get(slot, ()))
+
+
+def gather_slot(k_pages, v_pages, table_row, length: int, layer: int = 0):
+    """Host/test helper: the contiguous (length, n_kv_heads, head_dim)
+    K and V of one slot, gathered through its table row — the dense
+    view the parity tests compare the kernel against."""
+    c_page = k_pages.shape[3]
+    n = -(-length // c_page)
+    k = k_pages[layer][:, np.asarray(table_row[:n])]   # (hkv, n, page, d)
+    v = v_pages[layer][:, np.asarray(table_row[:n])]
+    k = k.reshape(k.shape[0], -1, k.shape[-1])[:, :length]
+    v = v.reshape(v.shape[0], -1, v.shape[-1])[:, :length]
+    return k.transpose(1, 0, 2), v.transpose(1, 0, 2)

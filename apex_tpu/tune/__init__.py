@@ -18,8 +18,12 @@ Three pieces:
 
 Tunable surfaces wired in this round: flash attention block_q/block_k +
 heads_per_step head packing (ops/flash_attention.py), the softmax and
-layer-norm row blocks (via ops._common.tuned_row_block), and the flat
-optimizer kernels' rows-per-block (ops/optimizer_kernels.py).
+layer-norm row blocks (via ops._common.tuned_row_block), the flat
+optimizer kernels' rows-per-block (ops/optimizer_kernels.py), and the
+serving path (ISSUE 8): `flash_decode` heads_per_step (key:
+decode_attrs) and the paged KV cache's page size (`serve_page`, key:
+serve_page_attrs — the page IS the decode kernel's kv block, so the
+one knob tunes both the DMA unit and the pool granularity).
 """
 
 from apex_tpu.tune.cache import (  # noqa: F401
@@ -57,6 +61,34 @@ def flash_attrs(b, h, sq, sk, d, dtype, causal, bias="none", seg=False):
     return dict(b=int(b), h=int(h), sq=int(sq), sk=int(sk), d=int(d),
                 dtype=jnp.dtype(dtype).name, causal=bool(causal),
                 bias=bias, seg=bool(seg))
+
+
+def decode_attrs(n_slots, q_len, hq, hkv, d, page_size, dtype):
+    """The ONE definition of the `flash_decode` lookup-key attrs —
+    shared by the runtime lookup (ops/flash_decode.py), the sweep
+    driver (tune/search.py), and committed defaults.  n_slots is
+    pow2-bucketed: the continuous-batching engine (apex_tpu.serve)
+    keeps the slot count static per deployment, but sweeps shouldn't
+    fragment the cache across nearby concurrencies.  dtype None means
+    the serving cache dtype, bfloat16."""
+    import jax.numpy as jnp
+
+    dtype = jnp.bfloat16 if dtype is None else dtype
+    return dict(slots=pow2_bucket(n_slots), ql=int(q_len), hq=int(hq),
+                hkv=int(hkv), d=int(d), page=int(page_size),
+                dtype=jnp.dtype(dtype).name)
+
+
+def serve_page_attrs(n_kv_heads, head_dim, dtype):
+    """Lookup-key attrs for the `serve_page` op — the paged-KV-cache
+    page size (serve.KVCacheConfig).  The page size IS the decode
+    kernel's kv block size (one page = one DMA unit), so it is keyed
+    by the cache layout alone, not by concurrency."""
+    import jax.numpy as jnp
+
+    dtype = jnp.bfloat16 if dtype is None else dtype
+    return dict(hkv=int(n_kv_heads), d=int(head_dim),
+                dtype=jnp.dtype(dtype).name)
 
 
 def tuned(op: str, attrs=None, **kw):

@@ -475,6 +475,68 @@ def _zero2_bucket_sweep(on_tpu):
     return {"dp": dp, "tokens_per_sec": out}
 
 
+def _serve_decode_bench(on_tpu):
+    """Continuous-batching decode throughput + per-token latency at N
+    concurrent ragged streams (ISSUE 8 — the serving bench axes the
+    "millions of users" north star is judged by).  Each concurrency
+    level builds the flagship serve engine (apex_tpu.serve; GPT-350M
+    weights on TPU, the smoke config on CPU), submits N ragged-length
+    prompts, and drives the engine to completion through
+    `serve.measure_decode` — the shared drive-and-measure helper
+    (examples/serve_gpt.py quotes the same convention): device-synced
+    per-step timing, throughput over tokens ACTUALLY emitted, p50/p99
+    per-token latency over pure decode steps with admission/
+    retirement churn steps excluded.  The RecompileSentry verdict
+    rides out as `recompile_ok` — False means churn retraced the
+    decode step, which is a correctness bug, not a perf number."""
+    import numpy as np
+
+    from apex_tpu.serve import build_flagship_engine, measure_decode
+
+    streams = (1, 8, 64, 256) if on_tpu else (1, 8)
+    sweep = {}
+    params = None                   # one flagship init, shared by the sweep
+    for n in streams:
+        eng = build_flagship_engine(on_tpu, n_slots=n, params=params)
+        params = eng.params
+        rng = np.random.RandomState(0)
+        mp = eng.serve_cfg.max_prompt_len
+        max_new = eng.serve_cfg.max_new_cap if on_tpu else 8
+        for _ in range(n):
+            plen = int(rng.randint(1, mp + 1))
+            eng.submit(rng.randint(
+                0, eng.model_cfg.vocab_size, plen).tolist(), max_new)
+        m = measure_decode(eng, max_steps=16 * max_new + 64)
+        sweep[str(n)] = {
+            "tokens_per_sec": round(m["tokens_per_sec"], 1),
+            "p50_ms": round(m["p50_ms"], 3),
+            "p99_ms": round(m["p99_ms"], 3),
+            "steps": m["steps"],
+            "churn_steps": m["churn_steps"],
+            "recompile_ok": m["recompile_ok"],
+        }
+    return sweep
+
+
+def _stamp_serve(result, sweep):
+    """Fold the serve sweep into the result JSON: the full dict under
+    `serving` (deliberately OUTSIDE the `serve_` prefix — that prefix
+    is reserved for JSON scalars by SCHEMA v5, the `comms_` rule) and
+    the flat `serve_*` scalars from the LARGEST concurrency (the
+    headline serving number).  The recompile verdict is the AND over
+    the whole sweep — one churned concurrency poisons the stamp,
+    deliberately."""
+    result["serving"] = sweep
+    top_n = max(sweep, key=int)
+    top = sweep[top_n]
+    result["serve_streams"] = int(top_n)
+    result["serve_decode_tokens_per_sec"] = float(top["tokens_per_sec"])
+    result["serve_p50_ms"] = float(top["p50_ms"])
+    result["serve_p99_ms"] = float(top["p99_ms"])
+    result["serve_recompile_ok"] = all(
+        v["recompile_ok"] for v in sweep.values())
+
+
 def _adam_1b_step_ms(on_tpu):
     """Fused flat-buffer Adam step at 1B params (fp32 p/m/v, bf16
     grads) — the large-param optimizer north star (BASELINE.md;
@@ -732,6 +794,15 @@ def main():
                                                on_tpu)
     except Exception as e:
         result["zero2_n_buckets_error"] = repr(e)[:120]
+    # serving axes (ISSUE 8): decode tokens/s + p50/p99 per-token
+    # latency at N concurrent streams, and the sentry's churn verdict
+    # (_stamp_serve: flat serve_* scalars + the full sweep dict)
+    try:
+        with _timed(durations, "serve_decode"):
+            sweep = _retry(_serve_decode_bench, on_tpu)
+        _stamp_serve(result, sweep)
+    except Exception as e:
+        result["serve_error"] = repr(e)[:120]
     try:
         with _timed(durations, "long_context_32k"):
             lc_ms, lc_tps = _retry(_long_context_32k, on_tpu)

@@ -184,13 +184,31 @@ def _build_anatomy(target):
     return step, args
 
 
+def _build_serve():
+    """The flagship serving DECODE step (apex_tpu.serve, ISSUE 8).
+    Single-chip serving emits ZERO collectives — this target is the
+    standing negative control: any collective appearing in the decode
+    inventory is a regression (an accidental cross-slot reduction
+    would serialize every concurrent stream), and a future
+    tensor-parallel serving path must move it OFF this gate into an
+    allowlist-reviewed pattern, the PR 7 NOTE workflow."""
+    import jax
+
+    from apex_tpu.serve import build_flagship_engine
+
+    on_tpu = jax.default_backend() not in ("cpu",)
+    eng = build_flagship_engine(on_tpu)
+    return eng.decode_step, (eng.params, eng.kv, eng.state)
+
+
 BUILDERS = {
     "gpt_zero2": lambda: _build_gpt_zero2(
         __import__("jax").default_backend() not in ("cpu",)),
     "gpt": lambda: _build_anatomy("350m"),
     "bert": lambda: _build_anatomy("bert"),
+    "serve": _build_serve,
 }
-DEFAULT_TARGETS = ("gpt_zero2", "gpt")
+DEFAULT_TARGETS = ("gpt_zero2", "gpt", "serve")
 
 
 def _gate_report(rep_dict, target, allowlist, as_json) -> int:

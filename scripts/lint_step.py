@@ -141,8 +141,21 @@ def _build_resnet(on_tpu):
     return step, (state, scaler, mstate, (x, y))
 
 
+def _build_serve(on_tpu):
+    """The flagship serving DECODE step (apex_tpu.serve, ISSUE 8): the
+    continuous-batching program that must stay HS4xx-clean — a host
+    sync inside it would serialize every concurrent stream.  Built via
+    the shared serve builder (the exact bench/example program); the
+    smoke slot count keeps the CPU trace fast while exercising the
+    full paged-attention + state-update jaxpr."""
+    from apex_tpu.serve import build_flagship_engine
+
+    eng = build_flagship_engine(on_tpu)
+    return eng.decode_step, (eng.params, eng.kv, eng.state)
+
+
 BUILDERS = {"gpt": _build_gpt, "bert": _build_bert,
-            "resnet": _build_resnet}
+            "resnet": _build_resnet, "serve": _build_serve}
 
 
 def main() -> int:

@@ -426,7 +426,8 @@ def test_validate_record_v4_comms_fields_roundtrip(tmp_path):
     exactly where the backend withholds the plane (roofline/overlap),
     never for the inventory totals, and survive a JSONLSink round
     trip under the prefix-scalar rule."""
-    assert monitor.SCHEMA_VERSION == 4
+    # fields introduced in v4 stay valid in every later version
+    assert monitor.SCHEMA_VERSION >= 4
     base = {"monitor_schema_version": monitor.SCHEMA_VERSION, "step": 1,
             "loss": 1.0, "grad_norm": 0.1, "param_norm": 1.0,
             "update_norm": 0.0, "loss_scale": 1.0, "overflow_count": 0,
@@ -521,6 +522,11 @@ def test_comms_probe_cli_flagships_clean():
     rs = [c for c in zero2["report"]["collectives"]
           if c["kind"] == "reduce-scatter"]
     assert len(rs) >= 4 and all(c["axes"] == ["dp"] for c in rs)
+    # the serve decode step (ISSUE 8) is the standing negative
+    # control: single-chip serving must emit ZERO collectives
+    serve = next(x for x in reports if x["target"] == "serve")
+    assert serve["report"]["collectives"] == []
+    assert serve["new"] == []
 
 
 def test_comms_probe_gates_serialized_report():

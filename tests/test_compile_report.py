@@ -313,3 +313,56 @@ def test_hbm_watermarks_tolerates_fake_stats_shapes():
     empty = wm.hbm_watermarks(stats={})
     assert set(empty) == {f"hbm_{k}" for k in wm.WATERMARK_FIELDS}
     assert all(v is None for v in empty.values())
+
+
+def test_budget_classifies_kv_cache_row():
+    """ISSUE 8 satellite: args named `*kv_cache*`/`*page*` land in the
+    budget's `kv_cache` class (the serve report must price the pool
+    separately from weights — it scales with concurrent users, not
+    model size), a bare `params` arg lands in `params`, and training
+    steps without a pool keep a zero row that the renderer hides."""
+
+    def step(params, kv_cache, page_table, state, batch):
+        o = (params["w"] * kv_cache["k_pages"].sum()
+             + page_table.sum() + state.sum() + batch.sum())
+        return o.sum()
+
+    jitted = jax.jit(step)
+    args = (
+        {"w": jnp.ones((8, 8))},                       # 256 B
+        {"k_pages": jnp.zeros((4, 16, 8), jnp.float32),  # 2048 B
+         "v_pages": jnp.zeros((4, 16, 8), jnp.float32)},  # 2048 B
+        jnp.zeros((16,), jnp.int32),                   # 64 B (page arg)
+        jnp.zeros((32,), jnp.float32),
+        jnp.zeros((4, 4), jnp.float32),
+    )
+    rep = monitor.analyze_step(
+        jitted, args, donated=(),
+        arg_names=("params", "kv_cache", "page_table", "state", "batch"))
+    assert rep.budget["kv_cache"] == 4096 + 64
+    assert rep.budget["params"] == 256
+    assert rep.budget["inputs"] == 32 * 4 + 16 * 4
+    table = monitor.render_budget_table(rep)
+    assert "kv cache (pages)" in table
+
+    # a pool-free program keeps kv_cache == 0 and the renderer drops
+    # the row (training tables unchanged)
+    rep2 = monitor.analyze_step(
+        jitted, args, donated=(),
+        arg_names=("a", "b", "c", "d", "e"))
+    assert rep2.budget["kv_cache"] == 0
+    assert "kv cache" not in monitor.render_budget_table(rep2)
+
+
+def test_serve_decode_step_budget_prices_pool():
+    """End-to-end: the flagship serve engine's decode step audits with
+    the pool priced in the kv_cache row — exactly the engine's own
+    pool bytes — and donation of cache + state verified."""
+    from apex_tpu.serve import build_flagship_engine
+
+    eng = build_flagship_engine(False, n_slots=4)
+    rep = monitor.analyze_step(eng.decode_step,
+                               (eng.params, eng.kv, eng.state))
+    assert rep.budget["kv_cache"] == eng.kv_config.pool_bytes()
+    assert rep.budget["params"] > 0
+    assert rep.donation_ok is True

@@ -76,3 +76,15 @@ def test_train_with_monitor_runs(tmp_path):
         assert rec["step_time_ms"] > 0
     assert any("train-step-time" in rec for rec in records), \
         "Timers.write scalars missing from the JSONL stream"
+
+
+def test_serve_gpt_runs_64_streams():
+    """ISSUE 8 acceptance: the continuous-batching demo decodes N=64
+    concurrent ragged streams on the CPU smoke config with ZERO
+    steady-state recompiles — the script itself exits nonzero if the
+    sentry tripped or any request failed to retire."""
+    r = _run("serve_gpt.py", "--streams", "64",
+             "--force-cpu-devices", "1")
+    assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
+    assert "serve_gpt: OK (zero steady-state recompiles)" in r.stdout
+    assert "decoded 64 requests" in r.stdout

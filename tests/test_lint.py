@@ -492,6 +492,7 @@ def test_lint_step_cli_flagships_clean():
     """The acceptance gate: `scripts/lint_step.py` exits 0 on the
     flagship GPT/BERT step functions with the EMPTY committed
     allowlist."""
-    r = _run_script(ROOT / "scripts" / "lint_step.py", "gpt", "bert")
+    r = _run_script(ROOT / "scripts" / "lint_step.py", "gpt", "bert",
+                    "serve")
     assert r.returncode == 0, r.stdout + r.stderr
     assert "CLEAN" in r.stdout
