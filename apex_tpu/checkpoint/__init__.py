@@ -1,0 +1,59 @@
+"""Preemption-proof checkpointing (ISSUE 9, ROADMAP item 4).
+
+Three layers (docs/checkpointing.md):
+
+* `legacy` — the original whole-pytree save/load surface
+  (`save_checkpoint` / `load_checkpoint` / `latest_step`, orbax-backed
+  with a pickle fallback) for model weights and small state.
+* `sharded` + `manager` — the shard-native format: each dp rank's
+  ZeRO-2 flat-buffer shard persists as raw bytes under an atomically
+  committed manifest; `CheckpointManager` takes the device→host copy
+  off the hot path (double-buffered background writer) and
+  `restore_sharded` re-lays a dp=N checkpoint out for dp=M (elastic
+  resume; equal topology is bitwise).
+* `chaos` — the fault-injection harness: fail points inside the
+  writer, host-side corruption helpers, the flight-recorder
+  `resume_guard`, and the `LostRankWatchdog` that turns a lost rank
+  into a crash dump naming the last committed step instead of a hang.
+
+`scripts/resume_probe.py` is the standing CI gate over the whole
+stack: save → kill → restore → trajectory-match.
+"""
+
+from apex_tpu.checkpoint import chaos  # noqa: F401
+from apex_tpu.checkpoint.legacy import (  # noqa: F401
+    latest_step,
+    load_checkpoint,
+    save_checkpoint,
+)
+from apex_tpu.checkpoint.manager import CheckpointManager  # noqa: F401
+from apex_tpu.checkpoint.sharded import (  # noqa: F401
+    CKPT_SCHEMA_VERSION,
+    CheckpointError,
+    IncompleteCheckpointError,
+    LayoutMismatchError,
+    latest_committed_step,
+    read_manifest,
+    restore_sharded,
+    save_sharded,
+    validate_manifest,
+    verify_shards,
+)
+
+__all__ = [
+    "CKPT_SCHEMA_VERSION",
+    "CheckpointError",
+    "CheckpointManager",
+    "IncompleteCheckpointError",
+    "LayoutMismatchError",
+    "chaos",
+    "latest_committed_step",
+    "latest_step",
+    "load_checkpoint",
+    "read_manifest",
+    "restore_sharded",
+    "save_checkpoint",
+    "save_sharded",
+    "validate_manifest",
+    "verify_shards",
+]

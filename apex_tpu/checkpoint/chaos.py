@@ -1,0 +1,213 @@
+"""Fault injection for the checkpoint/restore path (ISSUE 9 layer 3).
+
+Production preemptions are not polite: the SIGKILL lands mid-shard-file,
+mid-manifest, or mid-step, and a flaky host makes one dp rank silently
+slow instead of dead.  This module makes every one of those a
+REPRODUCIBLE test:
+
+* **Fail points** — `arm("ckpt.mid_shards", count=2)` makes the writer
+  raise `SimulatedPreemption` at its 2nd named checkpoint inside
+  `sharded.save_sharded` (points: ``ckpt.before_shards``,
+  ``ckpt.mid_shards`` — checked after EVERY shard file,
+  ``ckpt.before_manifest``).  Because the manifest rename is the commit,
+  any of these leaves the directory unloadable and the PREVIOUS commit
+  the resume point — which is exactly what the chaos tests assert.
+* **Host-side corruption** — `truncate_shard` / `delete_shard` /
+  `corrupt_manifest` damage an already-committed checkpoint the way a
+  dying disk or a half-synced object store does; `verify_shards` must
+  then refuse it with the missing ranks named.
+* **`resume_guard`** — `FlightRecorder.guard()` with the resume point in
+  the story: any exception dumps a crash report whose reason names the
+  LAST COMMITTED step (no recorder schema change — the resume point
+  rides in the reason string the renderer already prints).
+* **`LostRankWatchdog`** — the PR-4 straggler detector's persistent
+  flag, escalated: a rank past `deadline` consecutive outlier steps
+  raises `RankLostError` (naming the rank, its skew, and the last
+  committed step) instead of letting the next collective hang forever.
+  Run the loop under `resume_guard` and a lost rank produces a crash
+  dump + a clean resume point, the veScale fault-tolerance posture.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+from typing import Dict, Optional
+
+from apex_tpu.checkpoint.sharded import MANIFEST
+
+
+class SimulatedPreemption(RuntimeError):
+    """Raised by an armed fail point — stands in for the SIGKILL."""
+
+
+class RankLostError(RuntimeError):
+    """A dp rank is declared lost/stalled by the watchdog."""
+
+
+_ARMED: Dict[str, int] = {}
+
+POINTS = ("ckpt.before_shards", "ckpt.mid_shards", "ckpt.before_manifest")
+
+
+def arm(point: str, count: int = 1) -> None:
+    """Arm `point` to fire on its `count`-th check (count=1: the next)."""
+    if point not in POINTS:
+        raise ValueError(f"unknown fail point {point!r}; choices: {POINTS}")
+    if count < 1:
+        raise ValueError(f"count must be >= 1, got {count}")
+    _ARMED[point] = count
+
+
+def disarm_all() -> None:
+    _ARMED.clear()
+
+
+def check(point: str) -> None:
+    """Called by the checkpoint writer at its named points; raises when
+    the countdown armed for `point` reaches zero.  A no-op (one dict
+    lookup) when nothing is armed — the production path pays nothing."""
+    n = _ARMED.get(point)
+    if n is None:
+        return
+    if n <= 1:
+        _ARMED.pop(point, None)
+        raise SimulatedPreemption(f"simulated preemption at {point}")
+    _ARMED[point] = n - 1
+
+
+@contextlib.contextmanager
+def preempt_at(point: str, count: int = 1):
+    """Scoped arming: the fail point is disarmed on exit even when the
+    body died somewhere else first."""
+    arm(point, count)
+    try:
+        yield
+    finally:
+        _ARMED.pop(point, None)
+
+
+# ---------------------------------------------------------------------------
+# host-side corruption of a COMMITTED checkpoint
+# ---------------------------------------------------------------------------
+
+def _shard_file(path: str, field: str, rank: int) -> str:
+    m_path = os.path.join(path, MANIFEST)
+    with open(m_path) as f:
+        m = json.load(f)
+    entry = m["fields"][field]
+    for fe in entry["files"]:
+        if fe["rank"] == rank:
+            return os.path.join(path, fe["file"])
+    raise ValueError(f"field {field!r} has no rank {rank}")
+
+
+def truncate_shard(path: str, field: str, rank: int = 0,
+                   keep_bytes: int = 7) -> str:
+    """Chop a committed shard file down to `keep_bytes` — the
+    half-synced-disk failure.  Returns the damaged file's path."""
+    fp = _shard_file(path, field, rank)
+    with open(fp, "rb") as f:
+        head = f.read(keep_bytes)
+    with open(fp, "wb") as f:
+        f.write(head)
+    return fp
+
+
+def delete_shard(path: str, field: str, rank: int = 0) -> str:
+    fp = _shard_file(path, field, rank)
+    os.remove(fp)
+    return fp
+
+
+def corrupt_manifest(path: str, mode: str = "truncate") -> str:
+    """Damage the manifest itself: ``truncate`` chops its JSON mid-byte
+    (an interrupted overwrite), ``stale`` rewrites it to reference a
+    shard file that no longer exists (manifest and data out of sync).
+    Either way `read_manifest`/`verify_shards` must refuse loudly."""
+    mf = os.path.join(path, MANIFEST)
+    if mode == "truncate":
+        with open(mf, "rb") as f:
+            raw = f.read()
+        with open(mf, "wb") as f:
+            f.write(raw[: max(1, len(raw) // 2)])
+    elif mode == "stale":
+        with open(mf) as f:
+            m = json.load(f)
+        first = next(iter(m["fields"]))
+        m["fields"][first]["files"][0]["file"] = "gone.rank000.bin"
+        with open(mf, "w") as f:
+            json.dump(m, f)
+    else:
+        raise ValueError(f"mode must be 'truncate' or 'stale', got {mode!r}")
+    return mf
+
+
+# ---------------------------------------------------------------------------
+# crash-dump wiring (PR-4 flight recorder + straggler detector)
+# ---------------------------------------------------------------------------
+
+@contextlib.contextmanager
+def resume_guard(recorder, manager):
+    """`FlightRecorder.guard()` that names the resume point: on ANY
+    exception (a real crash, a `SimulatedPreemption`, a
+    `RankLostError`) the flight report's reason carries the last
+    COMMITTED checkpoint step, so the operator reading the dump knows
+    where `restore()` will land WITHOUT trusting the dying process.
+    No recorder schema change — the resume point rides in the reason
+    string `scripts/flight_report.py` already renders."""
+    import apex_tpu.monitor.compile.watermarks as wm
+
+    try:
+        yield recorder
+    except BaseException as e:
+        last = manager.last_committed_step if manager is not None else None
+        where = (f"step {last}" if last is not None
+                 else "NONE COMMITTED — restart from scratch")
+        recorder.dump(
+            reason=f"exception: {e!r}; last committed checkpoint: {where}",
+            oom=wm.is_oom(e))
+        raise
+
+
+class LostRankWatchdog:
+    """Escalates the PR-4 `StragglerDetector`'s persistent flag into a
+    loud, dump-carrying failure instead of a collective hang.
+
+    Feed it each step's gathered (n_ranks, k) timing matrix (or call
+    `check()` after updating a shared detector yourself).  Once any
+    rank has been an outlier for `deadline` CONSECUTIVE steps it raises
+    `RankLostError` naming the rank, its skew, and — when a manager is
+    attached — the last committed checkpoint step.  Under
+    `resume_guard` that exception becomes a crash dump whose reason IS
+    the resume runbook."""
+
+    def __init__(self, straggler, manager=None, deadline: int = 10):
+        if deadline < 1:
+            raise ValueError(f"deadline must be >= 1, got {deadline}")
+        self.straggler = straggler
+        self.manager = manager
+        self.deadline = deadline
+
+    def check(self, timings=None) -> Optional[dict]:
+        """Fold `timings` (when given) and raise if any rank crossed the
+        deadline; returns the straggler's last summary otherwise."""
+        if timings is not None:
+            self.straggler.update(timings)
+        last = self.straggler.last
+        if not last:
+            return None
+        for f in last["flagged"]:
+            if f["consecutive"] >= self.deadline:
+                lc = (self.manager.last_committed_step
+                      if self.manager is not None else None)
+                where = (f"step {lc}" if lc is not None
+                         else "none committed")
+                raise RankLostError(
+                    f"rank {f['rank']} lost/stalled: {f['consecutive']} "
+                    f"consecutive steps beyond "
+                    f"{self.straggler.threshold}x the median (skew "
+                    f"{f['skew']:.2f}); resume from last committed "
+                    f"checkpoint: {where}")
+        return last

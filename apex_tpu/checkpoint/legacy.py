@@ -8,6 +8,16 @@ reference leaves to user scripts (examples/imagenet/main_amp.py save
 path).  Here it is first-class: orbax-backed sharded save/restore of
 arbitrary pytrees (params, optimizer flat buffers, scaler state), with
 a numpy fallback when orbax is unavailable.
+
+ISSUE 9 moved this surface into the `apex_tpu.checkpoint` package
+(imports are unchanged); the shard-native async format lives in
+`checkpoint.sharded` / `checkpoint.manager`.  `load_checkpoint` now
+recognizes that format too: a manifest directory is validated for
+shard COMPLETENESS (existence, sizes, checksums) before anything
+deserializes, so a truncated or missing shard raises
+`IncompleteCheckpointError` naming the missing ranks — and a short
+pickle raises a named CheckpointError — instead of the opaque
+deserialization tracebacks both used to surface as.
 """
 
 from __future__ import annotations
@@ -68,10 +78,33 @@ def load_checkpoint(path: str, step: Optional[int] = None,
     A checkpoint written in the orbax layout NEEDS orbax to read —
     there is no pickle to fall back to, so a missing install raises an
     ImportError that names the extra instead of the bare module-level
-    one."""
+    one.
+
+    A directory in the `checkpoint.sharded` manifest layout is
+    validated for shard completeness FIRST (`verify_shards` — a
+    missing/truncated shard raises IncompleteCheckpointError listing
+    the missing ranks) and returns the host-side field dict
+    ({name: array | [per-rank arrays]}); optimizer-state re-layout
+    goes through `checkpoint.restore_sharded` instead."""
     path = os.path.abspath(path)
     if step is not None:
         path = os.path.join(path, f"step_{step}")
+    from apex_tpu.checkpoint import sharded as _sh
+    if os.path.exists(os.path.join(path, _sh.MANIFEST)):
+        if target is not None:
+            raise ValueError(
+                "load_checkpoint(target=...) is not supported for a "
+                "sharded-manifest checkpoint — the field dict has no "
+                "single pytree structure to unflatten into; restore "
+                "optimizer state through checkpoint.restore_sharded "
+                "(which re-lays shards for the target optimizer)")
+        manifest = _sh.read_manifest(path)
+        # completeness swept cheaply; content crc rides the SAME read
+        # that deserializes (no second pass over the payload)
+        _sh.verify_shards(path, manifest, crc=False)
+        return {name: _sh.load_field_host(path, manifest, name,
+                                          check_crc=True)
+                for name in manifest["fields"]}
     orbax_path = os.path.join(path, "state")
     if os.path.exists(orbax_path):
         try:
@@ -87,8 +120,18 @@ def load_checkpoint(path: str, step: Optional[int] = None,
                 jax.tree_util.tree_structure(target),
                 jax.tree_util.tree_leaves(restored))
         return restored
-    with open(os.path.join(path, "state.pkl"), "rb") as f:
-        return pickle.load(f)
+    pkl = os.path.join(path, "state.pkl")
+    try:
+        with open(pkl, "rb") as f:
+            return pickle.load(f)
+    except (EOFError, pickle.UnpicklingError) as e:
+        from apex_tpu.checkpoint.sharded import CheckpointError
+        raise CheckpointError(
+            f"{pkl} is truncated or corrupt "
+            f"({os.path.getsize(pkl)} bytes): {e!r} — the save was "
+            "likely killed mid-write; the sharded format "
+            "(checkpoint.CheckpointManager) commits atomically and "
+            "names damaged shards instead") from e
 
 
 def latest_step(path: str) -> Optional[int]:

@@ -52,7 +52,16 @@ from apex_tpu.monitor.sinks import MetricSink, ScalarWriter
 # apex_tpu.serve) — all OPTIONAL, never-null when present (a serve
 # measurement that ran has all five), `serve_` prefix reserved for
 # JSON scalars like `comms_`.
-SCHEMA_VERSION = 5
+# v6 (ISSUE 9): the checkpointing fields — `ckpt_blocking_s` (what the
+# hot path paid for the newest save: wait-for-previous-write +
+# device→host snapshot), `ckpt_save_s` (the background writer's wall
+# clock for the same save), `ckpt_last_step` (the newest COMMITTED
+# step — the resume point), `ckpt_bytes` (committed payload size) —
+# all OPTIONAL, never-null when present (a logger without a
+# CheckpointManager attached, or one attached before the first save,
+# simply doesn't stamp them), `ckpt_` prefix reserved for JSON
+# scalars like `comms_`/`serve_`.
+SCHEMA_VERSION = 6
 
 # field -> (python type, finite_required).  loss_scale may legitimately
 # be large but is finite; grad/update norms are inf/nan ON overflow
@@ -104,8 +113,15 @@ OPTIONAL_SCHEMA = {
     "serve_p50_ms": (float, False),
     "serve_p99_ms": (float, False),
     "serve_recompile_ok": (bool, False),
+    # v6 (ISSUE 9): checkpoint-cadence pricing.  Present only once a
+    # CheckpointManager has committed a save; never null (the blocking
+    # and writer costs of a save that happened are real numbers).
+    "ckpt_blocking_s": (float, False),
+    "ckpt_save_s": (float, False),
+    "ckpt_last_step": (int, False),
+    "ckpt_bytes": (int, False),
 }
-_OPTIONAL_PREFIXES = ("compile_", "hbm_", "comms_", "serve_")
+_OPTIONAL_PREFIXES = ("compile_", "hbm_", "comms_", "serve_", "ckpt_")
 
 
 def validate_record(record: dict, prev_step: Optional[int] = None) -> None:
@@ -195,7 +211,8 @@ class MetricsLogger:
                  taps: bool = False,
                  sentry=None,
                  memory: bool = False,
-                 memory_device=None):
+                 memory_device=None,
+                 ckpt=None):
         self.sinks = list(sinks)
         self.flops_per_step = flops_per_step
         # None resolves the per-chip peak from the device kind (ISSUE 5
@@ -215,6 +232,12 @@ class MetricsLogger:
         self.sentry = sentry
         self.memory = memory
         self.memory_device = memory_device
+        # ckpt: a checkpoint.CheckpointManager — every record gains the
+        # ckpt_* cadence-pricing scalars of the newest committed save
+        # (ISSUE 9; nothing is stamped before the first save), so the
+        # JSONL stream shows what checkpointing cost next to the
+        # step-time it may have inflated.
+        self.ckpt = ckpt
         # taps=True: log_step(…, taps=tap_state) folds the flight
         # recorder's per-layer stat planes into each record as compact
         # summary fields (tap_fwd_absmax / tap_grad_absmax /
@@ -312,6 +335,8 @@ class MetricsLogger:
         if self.memory:
             import apex_tpu.monitor.compile.watermarks as _wm
             record.update(_wm.hbm_watermarks(self.memory_device))
+        if self.ckpt is not None:
+            record.update(self.ckpt.stats())
         if extra:
             record.update(extra)
         for s in self.sinks:

@@ -117,6 +117,27 @@ class _ShardedFlat(F.FlatCheckpointMixin):
             P() if f == "step" else P(self.axis_name)
             for f in self._STATE._fields])
 
+    def shard_layout(self) -> dict:
+        """Static description of THIS optimizer's flat shard layout —
+        the re-layout contract `apex_tpu.checkpoint`'s manifests record
+        (ISSUE 9): enough to reassemble the canonical align-padded flat
+        content from per-rank shard files written at ANY
+        (num_shards, n_buckets) and re-slice it for this one.
+        Subclasses with bucketed layouts override the bucket rows."""
+        import jax.numpy as jnp
+        if self.spec is None:
+            raise RuntimeError(
+                f"{type(self).__name__}.shard_layout() before init(); "
+                "call init(params) first so the flat layout is fixed")
+        return {"align": int(self.spec.align),
+                "total": int(self.spec.total),
+                "n_tensors": len(self.spec.sizes),
+                "num_shards": int(self.num_shards),
+                "n_buckets": 1,
+                "bucket_totals": [int(self.spec.total)],
+                "bucket_padded": [int(self.padded_total)],
+                "master_dtype": str(jnp.dtype(self.master_dtype))}
+
 
 class DistributedFusedAdam(_ShardedFlat):
     """ZeRO-2 Adam.  Shard-local: init/step run inside shard_map with the
@@ -225,6 +246,17 @@ class DistributedFusedAdam(_ShardedFlat):
     def state_dict(self, state) -> dict:
         d = super().state_dict(state)
         d["flat_layout"]["n_buckets"] = self.n_buckets
+        return d
+
+    def shard_layout(self) -> dict:
+        """The bucket-major layout (see _ShardedFlat.shard_layout): a
+        rank's shard is the concat over buckets of its 1/num_shards
+        chunk, so the checkpoint re-layout needs every bucket's
+        (total, padded) pair."""
+        d = super().shard_layout()
+        d["n_buckets"] = len(self._ranges)
+        d["bucket_totals"] = [int(s.total) for s in self.bucket_specs]
+        d["bucket_padded"] = [int(p) for p in self._bucket_padded]
         return d
 
     def load_state_dict(self, d: dict):

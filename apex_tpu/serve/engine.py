@@ -560,6 +560,99 @@ class DecodeEngine:
             "sentry": self.sentry.summary(),
         }
 
+    # ------------------------------------------------------------------
+    # checkpoint / preemption resume (ISSUE 9)
+    # ------------------------------------------------------------------
+
+    _SERVE_STATE_VERSION = 1
+
+    def _deployment_fingerprint(self) -> dict:
+        """The static knobs that bake into the compiled step — a
+        snapshot only restores into the SAME deployment (shapes never
+        change; a mismatch would mean silently different programs)."""
+        c, s, k = self.model_cfg, self.serve_cfg, self.kv_config
+        return {"n_slots": s.n_slots, "max_prompt_len": s.max_prompt_len,
+                "max_new_cap": s.max_new_cap, "eos_id": s.eos_id,
+                "page_size": k.page_size, "n_pages": k.n_pages,
+                "n_layers": c.num_layers, "hidden": c.hidden,
+                "num_heads": c.num_heads, "vocab_size": c.vocab_size,
+                # dtypes are part of the deployment: a cross-dtype
+                # restore would silently cast the KV pool and break
+                # the bitwise resume contract without an error
+                "cache_dtype": str(jnp.dtype(k.dtype)),
+                "model_dtype": str(jnp.dtype(c.dtype))}
+
+    def state_dict(self) -> dict:
+        """Host snapshot of EVERYTHING a preempted serving node needs
+        to resume mid-generation: the paged KV pool, the per-slot
+        DecodeState, the allocator, and the scheduler queues.  The
+        weight pytree is deliberately NOT included — weights are the
+        deployment artifact, checkpointed separately (the serve-weights
+        round-trip test).  Round-trips through
+        `checkpoint.save_checkpoint`; restore into a FRESH engine of
+        the same deployment via `load_state_dict` and decoding
+        continues bitwise where it left off (tests/test_checkpoint.py
+        pins the resumed tokens to the unpreempted run's)."""
+        jax.block_until_ready((self.kv, self.state))
+        return {
+            "serve_state_version": self._SERVE_STATE_VERSION,
+            "deployment": self._deployment_fingerprint(),
+            "kv": {k: np.asarray(v) for k, v in self.kv.items()},
+            "decode_state": {k: np.asarray(v)
+                             for k, v in self.state._asdict().items()},
+            "cache": self.cache.state_dict(),
+            "scheduler": {
+                "next_rid": self._next_rid,
+                "pending": [[rid, list(p), mn]
+                            for rid, p, mn in self._pending],
+                "free_slots": list(self._free_slots),
+                "live": {int(s): [rid, list(p)]
+                         for s, (rid, p) in self._live.items()},
+                "finished": [[f.request_id, list(f.prompt),
+                              list(f.tokens)] for f in self._finished],
+            },
+        }
+
+    def load_state_dict(self, d: dict) -> None:
+        """Inverse of state_dict into a fresh engine of the SAME
+        deployment (the fingerprint is validated field by field — the
+        compiled step's shapes depend on every one of them).  The
+        restored engine recompiles its decode step on first use (a
+        fresh process has an empty jit cache); after that warmup the
+        zero-steady-recompile contract holds as before."""
+        ver = d.get("serve_state_version")
+        if ver != self._SERVE_STATE_VERSION:
+            raise ValueError(
+                f"serve_state_version {ver!r} != "
+                f"{self._SERVE_STATE_VERSION}")
+        want = self._deployment_fingerprint()
+        got = d.get("deployment") or {}
+        bad = [k for k in want if got.get(k) != want[k]]
+        if bad:
+            raise ValueError(
+                "DecodeEngine.load_state_dict: snapshot is from a "
+                "different deployment — mismatched " + ", ".join(
+                    f"{k} (snapshot {got.get(k)!r} != engine "
+                    f"{want[k]!r})" for k in bad))
+        cfg = self.kv_config
+        self.kv = {k: jnp.asarray(v).astype(cfg.dtype)
+                   for k, v in d["kv"].items()}
+        ds = {k: jnp.asarray(v) for k, v in d["decode_state"].items()}
+        self.state = DecodeState(**ds)
+        self.cache.load_state_dict(d["cache"])
+        sch = d["scheduler"]
+        self._next_rid = int(sch["next_rid"])
+        self._pending = collections.deque(
+            (int(rid), [int(t) for t in p], int(mn))
+            for rid, p, mn in sch["pending"])
+        self._free_slots = [int(s) for s in sch["free_slots"]]
+        self._live = {int(s): (int(rid), [int(t) for t in p])
+                      for s, (rid, p) in sch["live"].items()}
+        self._finished = [
+            FinishedRequest(request_id=int(rid), prompt=[int(t) for t in p],
+                            tokens=[int(t) for t in toks])
+            for rid, p, toks in sch["finished"]]
+
 
 def measure_decode(eng: DecodeEngine, *, warm: int = 2,
                    max_steps: Optional[int] = None) -> dict:

@@ -230,6 +230,44 @@ class PagedKVCache:
     def slot_pages(self, slot: int) -> List[int]:
         return list(self._slot_pages.get(slot, ()))
 
+    # ------------------------- checkpoint (ISSUE 9) ------------------
+
+    def state_dict(self) -> dict:
+        """Host snapshot of the allocator: free list, table mirror,
+        slot→pages assignments.  Together with the engine's device
+        planes this is everything a preempted serving node needs to
+        resume mid-generation (DecodeEngine.state_dict carries it)."""
+        return {"free": list(self._free),
+                "table": self._table.copy(),
+                "slot_pages": {int(s): list(p)
+                               for s, p in self._slot_pages.items()}}
+
+    def load_state_dict(self, d: dict) -> None:
+        """Inverse of state_dict under THIS config.  Validates the page
+        accounting (every page trash-or-accounted exactly once) so a
+        snapshot from a different deployment fails loudly instead of
+        double-allocating pages later."""
+        c = self.config
+        free = [int(p) for p in d["free"]]
+        slot_pages = {int(s): [int(p) for p in pp]
+                      for s, pp in d["slot_pages"].items()}
+        held = [p for pp in slot_pages.values() for p in pp]
+        accounted = sorted(free + held)
+        if accounted != list(range(1, c.n_pages)):
+            raise ValueError(
+                f"PagedKVCache.load_state_dict: snapshot accounts for "
+                f"{len(accounted)} pages, this deployment has "
+                f"{c.n_pages - 1} usable ones (n_pages={c.n_pages}) — "
+                "snapshot is from a different deployment or corrupt")
+        table = np.asarray(d["table"], np.int32)
+        if table.shape != self._table.shape:
+            raise ValueError(
+                f"PagedKVCache.load_state_dict: table shape "
+                f"{table.shape} != configured {self._table.shape}")
+        self._free = free
+        self._table = table.copy()
+        self._slot_pages = slot_pages
+
 
 def gather_slot(k_pages, v_pages, table_row, length: int, layer: int = 0):
     """Host/test helper: the contiguous (length, n_kv_heads, head_dim)

@@ -537,6 +537,102 @@ def _stamp_serve(result, sweep):
         v["recompile_ok"] for v in sweep.values())
 
 
+def _ckpt_cycle(on_tpu):
+    """One async save → elastic restore cycle of the flagship ZeRO-2
+    training state (ISSUE 9): prices the checkpoint cadence for the
+    bench JSON.  Uses the same dp-sharded GPT config as the zero2
+    bucket sweep (the shard-native path is what the tentpole is for;
+    the replicated flagship state saves through the identical
+    manager).  Stamps, via _stamp_ckpt: `ckpt_save_s` (writer-thread
+    wall clock), `ckpt_blocking_s` (what the hot path paid —
+    device→host snapshot; the write itself ran in the background),
+    `ckpt_bytes`, restore seconds, and a bitwise round-trip verdict
+    (False = the checkpoint that was just priced does not reproduce
+    the state, which voids the number)."""
+    import shutil
+    import tempfile
+
+    from apex_tpu.checkpoint import CheckpointManager
+    from apex_tpu.models.gpt import GPT, GPTConfig
+    from apex_tpu.optimizers.distributed_fused_adam import (
+        DistributedFusedAdam,
+    )
+    from apex_tpu.parallel import ddp
+    from apex_tpu.parallel import mesh as M
+    # after apex_tpu: _compat shims `jax.shard_map` on jax 0.4.x
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    if on_tpu:
+        batch, seq = 8, 1024
+        cfg = GPTConfig(vocab_size=50304, seq_len=seq, hidden=1024,
+                        num_layers=8, num_heads=16, dropout=0.0,
+                        dtype=jnp.bfloat16, logits_dtype=jnp.bfloat16,
+                        use_flash_attention=True)
+    else:
+        batch, seq = 2, 64
+        cfg = GPTConfig(vocab_size=512, seq_len=seq, hidden=64,
+                        num_layers=2, num_heads=4, dropout=0.0)
+    M.destroy_model_parallel()
+    mesh = M.initialize_model_parallel()
+    dp = mesh.devices.size
+    # batch must shard over dp (the comms_probe divisibility rule)
+    batch = -(-batch // dp) * dp
+    model = GPT(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+    opt = DistributedFusedAdam(
+        num_shards=dp, lr=1e-4, n_buckets=2, use_pallas=on_tpu or None,
+        master_dtype=jnp.bfloat16 if on_tpu else jnp.float32)
+    sspec = opt.state_partition_specs()
+    state = jax.jit(shard_map(
+        opt.init, mesh=mesh, in_specs=(P(),), out_specs=sspec,
+        check_vma=False))(params)
+    step = ddp.make_train_step(
+        lambda p, b: model.loss(p, b[0], b[1]), opt, mesh,
+        batch_spec=(P("dp"), P("dp")))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (batch, seq), 0,
+                                cfg.vocab_size)
+    labels = jnp.roll(tokens, -1, axis=1)
+    state, _, loss = step(state, None, (tokens, labels))
+    _ = np.asarray(loss)
+
+    tmpd = tempfile.mkdtemp(prefix="apex_ckpt_bench_")
+    try:
+        mgr = CheckpointManager(tmpd, opt, every_n_steps=1)
+        mgr.save(1, state)
+        mgr.wait()
+        st = mgr.stats()
+        t0 = time.perf_counter()
+        restored, _, _ = mgr.restore(mesh)
+        jax.block_until_ready(restored)
+        restore_s = time.perf_counter() - t0
+        # EVERY state field: a verdict that only checked the params
+        # would stamp ok=True over damaged moment shards
+        ok = all(
+            bool(np.array_equal(np.asarray(getattr(restored, f)),
+                                np.asarray(getattr(state, f))))
+            for f in state._fields)
+    finally:
+        shutil.rmtree(tmpd, ignore_errors=True)
+    M.destroy_model_parallel()
+    return {"dp": dp, "save_s": st["ckpt_save_s"],
+            "blocking_s": st["ckpt_blocking_s"],
+            "bytes": st["ckpt_bytes"],
+            "restore_s": round(restore_s, 6), "roundtrip_ok": ok}
+
+
+def _stamp_ckpt(result, cycle):
+    """Flat v6 `ckpt_*` scalars (the prefix is JSON-scalar-reserved,
+    the `comms_`/`serve_` rule) + the full cycle dict under
+    `checkpointing`."""
+    result["checkpointing"] = cycle
+    result["ckpt_save_s"] = float(cycle["save_s"])
+    result["ckpt_blocking_s"] = float(cycle["blocking_s"])
+    result["ckpt_bytes"] = int(cycle["bytes"])
+    result["ckpt_restore_s"] = float(cycle["restore_s"])
+    result["ckpt_roundtrip_ok"] = bool(cycle["roundtrip_ok"])
+
+
 def _adam_1b_step_ms(on_tpu):
     """Fused flat-buffer Adam step at 1B params (fp32 p/m/v, bf16
     grads) — the large-param optimizer north star (BASELINE.md;
@@ -803,6 +899,15 @@ def main():
         _stamp_serve(result, sweep)
     except Exception as e:
         result["serve_error"] = repr(e)[:120]
+    # checkpoint-cadence pricing (ISSUE 9): one async save → elastic
+    # restore cycle of the ZeRO-2 flagship state, stamped as flat
+    # ckpt_* v6 scalars (+ the dict under `checkpointing`)
+    try:
+        with _timed(durations, "ckpt_cycle"):
+            cycle = _retry(_ckpt_cycle, on_tpu)
+        _stamp_ckpt(result, cycle)
+    except Exception as e:
+        result["ckpt_error"] = repr(e)[:120]
     try:
         with _timed(durations, "long_context_32k"):
             lc_ms, lc_tps = _retry(_long_context_32k, on_tpu)

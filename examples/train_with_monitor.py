@@ -17,9 +17,18 @@ in the loop dumps a JSON crash report to PATH (render with
 step N to exercise exactly that path (the crash-dump integrity test,
 tests/test_trace.py).
 
+`--ckpt-dir PATH` arms preemption-proof checkpointing (ISSUE 9): a
+`checkpoint.CheckpointManager` saves the optimizer + scaler state
+every `--ckpt-every` steps (async, atomic-manifest commit), the logger
+stamps the ckpt_* cadence-pricing fields into the same JSONL, and
+`--resume` restores the latest COMMITTED step before training — run,
+kill, re-run with --resume and the loss trajectory continues where the
+last commit left it.
+
   python examples/train_with_monitor.py --steps 10 \\
       --jsonl /tmp/metrics.jsonl [--profile-dir /tmp/trace] \\
       [--flight-report /tmp/flight.json [--crash-at N]] \\
+      [--ckpt-dir /tmp/ckpt [--ckpt-every N] [--resume]] \\
       [--force-cpu-devices N]
 """
 import _bootstrap
@@ -53,6 +62,14 @@ def main():
                     help="flight-recorder ring depth (steps)")
     ap.add_argument("--crash-at", type=int, default=None,
                     help="raise mid-loop at this step (crash-dump demo)")
+    ap.add_argument("--ckpt-dir", default=None,
+                    help="arm async checkpointing; committed steps "
+                         "land under this directory")
+    ap.add_argument("--ckpt-every", type=int, default=5,
+                    help="checkpoint cadence in steps")
+    ap.add_argument("--resume", action="store_true",
+                    help="restore the latest committed checkpoint "
+                         "from --ckpt-dir before training")
     ap.add_argument("--force-cpu-devices", type=int, default=None,
                     help="handled by _bootstrap before jax init")
     args = ap.parse_args()
@@ -74,6 +91,23 @@ def main():
 
     opt = FusedAdam(lr=1e-3, use_pallas=False)
     opt_state = opt.init(params)
+
+    # preemption-proof checkpointing (ISSUE 9): async sharded saves on
+    # a cadence, resume from the latest COMMITTED step.  This demo's
+    # FusedAdam is replicated (the manager writes one shard); the
+    # ZeRO-2 optimizers persist per-rank shards through the same call.
+    manager = None
+    start_step = 0  # saves number from here: a resumed run must NOT
+    # restart at step 1 and overwrite pre-kill commits with later state
+    if args.ckpt_dir:
+        from apex_tpu.checkpoint import CheckpointManager
+        manager = CheckpointManager(args.ckpt_dir, opt,
+                                    every_n_steps=args.ckpt_every)
+        # the restore itself happens AFTER the warmup steps below —
+        # warmup exists to absorb compiles, and running it on the
+        # restored state would inject two extra optimizer updates per
+        # preempt/resume cycle (the trajectory would silently drift
+        # from the committed step)
 
     def loss_fn(p, batch):
         tokens, labels = batch
@@ -112,7 +146,7 @@ def main():
         [monitor.JSONLSink(args.jsonl), monitor.ConsoleSink()],
         flops_per_step=monitor.gpt_step_flops(cfg, args.batch),
         peak_flops=monitor.device_peak_flops() * dp,
-        taps=flight, sentry=sentry, memory=True)
+        taps=flight, sentry=sentry, memory=True, ckpt=manager)
     metrics = monitor.init_metrics()
     timers = Timers()
 
@@ -178,6 +212,20 @@ def main():
         out = run_step(batch, metrics, prev_durations)
         opt_state_box[0], scaler_box[0], _, metrics = out[:4]
     jax.block_until_ready(opt_state_box[0])
+    if manager is not None and args.resume:
+        # restore only now, with the compiles already paid on throwaway
+        # state: the resumed trajectory continues EXACTLY from the
+        # committed step (same shapes/shardings — nothing retraces)
+        if manager.last_committed_step is not None:
+            opt_state_box[0], restored_scaler, manifest = \
+                manager.restore(mesh)
+            if restored_scaler is not None:
+                scaler_box[0] = restored_scaler
+            start_step = int(manifest["step"])
+            print(f"resumed from committed checkpoint step {start_step}")
+        else:
+            print(f"--resume: no committed checkpoint under "
+                  f"{args.ckpt_dir}; starting fresh")
     logger.reset_timer(metrics)  # resync step/token baselines too
     sentry.mark_steady()  # compiles were expected until here; any
     # further one is a silent retrace — warned once, visible as
@@ -202,10 +250,17 @@ def main():
                                 timings=rank_timings,
                                 tap_names=step.tap_names())
             timers.write(["train-step"], logger.writer, i, reset=True)
+            if manager is not None:
+                manager.maybe_save(start_step + i + 1, opt_state_box[0],
+                                   scaler_box[0])
             if args.crash_at is not None and i == args.crash_at:
                 raise RuntimeError(
                     f"injected crash at step {i} (--crash-at)")
     cap.close()
+    if manager is not None:
+        manager.wait()
+        print(f"last committed checkpoint: step "
+              f"{manager.last_committed_step}")
     logger.close()
     print(f"wrote {args.steps} metric records to {args.jsonl} "
           f"({tokens_per_step} tokens/step)")

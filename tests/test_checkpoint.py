@@ -1,13 +1,45 @@
 """Checkpoint/resume ≡ tests/L0/run_amp/test_checkpointing.py: scaler
-state round-trips, optimizer/model state round-trips, auto-resume."""
+state round-trips, optimizer/model state round-trips, auto-resume —
+plus the ISSUE 9 preemption-proof stack: sharded-manifest commit
+atomicity, chaos fail points/corruption, elastic dp=N→M re-layout,
+CheckpointManager async saves + MetricsLogger ckpt_* stamps, the
+flight-recorder resume guard + lost-rank watchdog, serve-engine
+mid-generation resume, and the `scripts/resume_probe.py` CI gates."""
+
+import json
+import os
+import pathlib
+import subprocess
+import sys
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from apex_tpu import amp
-from apex_tpu.checkpoint import latest_step, load_checkpoint, save_checkpoint
+from apex_tpu.checkpoint import (
+    CheckpointManager,
+    IncompleteCheckpointError,
+    chaos,
+    latest_committed_step,
+    latest_step,
+    load_checkpoint,
+    save_checkpoint,
+    save_sharded,
+    verify_shards,
+)
+from apex_tpu.checkpoint import sharded as S
 from apex_tpu.optimizers.fused_adam import FusedAdam
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+
+
+def _run_script(path, *args, timeout=600):
+    return subprocess.run(
+        [sys.executable, str(path), *args], capture_output=True,
+        text=True, timeout=timeout,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
 
 
 def test_amp_state_roundtrip():
@@ -135,3 +167,548 @@ def test_serve_engine_weights_roundtrip(tmp_path):
     t2 = eng2.run()[0].tokens
     assert t1 == t2 and len(t1) == 6
     assert path.endswith("step_0")
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 9: the sharded format's commit protocol + validation
+# ---------------------------------------------------------------------------
+
+def _toy_sharded(tmp_path, step=7, n=2):
+    """A tiny committed 2-rank checkpoint for corruption tests."""
+    shards = list(np.split(np.arange(8 * n, dtype=np.float32), n))
+    return save_sharded(
+        str(tmp_path), step,
+        {"params_shard": ("sharded", shards),
+         "step": ("replicated", np.asarray(step, np.int32))},
+        flat_layout={"align": 1, "total": 8 * n, "n_tensors": 1,
+                     "num_shards": n, "n_buckets": 1,
+                     "bucket_totals": [8 * n], "bucket_padded": [8 * n],
+                     "master_dtype": "float32"})
+
+
+def test_sharded_commit_and_completeness(tmp_path):
+    """The atomicity + validation contract: a manifest-less directory
+    is never a checkpoint; a committed one validates; every corruption
+    mode (truncated shard, deleted shard, stale manifest, truncated
+    manifest) is refused LOUDLY with the damaged ranks named — before
+    anything deserializes (the ISSUE 9 satellite)."""
+    p = _toy_sharded(tmp_path)
+    assert latest_committed_step(str(tmp_path)) == 7
+    verify_shards(p)
+    # bitwise read-back through the legacy surface too
+    host = load_checkpoint(p)
+    np.testing.assert_array_equal(
+        np.concatenate(host["params_shard"]),
+        np.arange(16, dtype=np.float32))
+    assert int(host["step"]) == 7
+
+    # truncated shard: named error listing the rank, BEFORE deserialize
+    chaos.truncate_shard(p, "params_shard", rank=1)
+    with pytest.raises(IncompleteCheckpointError,
+                       match="rank 1.*truncated"):
+        load_checkpoint(p)
+    # ...and the step no longer counts as committed
+    assert latest_committed_step(str(tmp_path)) is None
+
+    # deleted shard
+    p2 = _toy_sharded(tmp_path / "b")
+    chaos.delete_shard(p2, "params_shard", rank=0)
+    with pytest.raises(IncompleteCheckpointError, match="rank 0.*missing"):
+        verify_shards(p2)
+
+    # stale manifest (references a file that's gone)
+    p3 = _toy_sharded(tmp_path / "c")
+    chaos.corrupt_manifest(p3, mode="stale")
+    with pytest.raises(IncompleteCheckpointError, match="missing"):
+        verify_shards(p3)
+
+    # truncated manifest: the COMMIT itself is corrupt
+    p4 = _toy_sharded(tmp_path / "d")
+    chaos.corrupt_manifest(p4, mode="truncate")
+    with pytest.raises(S.CheckpointError, match="not valid JSON"):
+        load_checkpoint(p4)
+
+    # crc mismatch at equal size: caught by the checksum sweep
+    p5 = _toy_sharded(tmp_path / "e")
+    f = os.path.join(p5, "params_shard.rank000.bin")
+    raw = bytearray(open(f, "rb").read())
+    raw[0] ^= 0xFF
+    open(f, "wb").write(bytes(raw))
+    with pytest.raises(IncompleteCheckpointError, match="crc32"):
+        verify_shards(p5)
+
+
+def test_kill_mid_save_never_commits(tmp_path):
+    """Chaos fail points at every writer stage: the directory left
+    behind is NOT loadable, the PREVIOUS commit stays the resume
+    point, and an async writer's death surfaces on the training thread
+    at wait() (a save that silently failed is a resume point that
+    doesn't exist)."""
+    opt = FusedAdam(lr=1e-2)
+    params = {"w": jnp.ones((300,)), "b": jnp.ones((7,))}
+    state = opt.init(params)
+    mgr = CheckpointManager(str(tmp_path), opt, every_n_steps=1,
+                            async_write=False)
+    mgr.save(1, state)
+    assert mgr.last_committed_step == 1
+
+    for point in chaos.POINTS:
+        with chaos.preempt_at(point):
+            with pytest.raises(chaos.SimulatedPreemption):
+                mgr.save(2, state)
+        # the partial never commits; step 1 remains the resume point
+        assert mgr.last_committed_step == 1, point
+        with pytest.raises(S.CheckpointError,
+                           match="not a committed checkpoint"):
+            S.read_manifest(S.step_dir(str(tmp_path), 2))
+    # ...and the latest COMMITTED manifest still restores
+    restored, _, _ = mgr.restore()
+    np.testing.assert_array_equal(np.asarray(restored.params),
+                                  np.asarray(state.params))
+
+    # async mode: the writer thread's death re-raises at wait()
+    mgr2 = CheckpointManager(str(tmp_path / "async"), opt,
+                             every_n_steps=1)
+    with chaos.preempt_at("ckpt.before_manifest"):
+        mgr2.save(3, state)
+        with pytest.raises(chaos.SimulatedPreemption):
+            mgr2.wait()
+    assert mgr2.last_committed_step is None
+
+
+def test_overwrite_of_committed_step_is_staged(tmp_path):
+    """Re-saving an ALREADY-COMMITTED step must never de-commit it
+    mid-write: the new attempt stages in a sibling .tmp dir and swaps
+    in only after its own manifest committed, so a kill anywhere
+    inside the overwrite leaves the ORIGINAL checkpoint loadable
+    (review finding: the old path cleared the manifest first)."""
+    p = _toy_sharded(tmp_path, step=5)
+    orig = load_checkpoint(p)
+
+    new_fields = {
+        "params_shard": ("sharded",
+                         list(np.split(np.full(16, 9.0, np.float32), 2))),
+        "step": ("replicated", np.asarray(5, np.int32))}
+    for point in chaos.POINTS:
+        with chaos.preempt_at(point):
+            with pytest.raises(chaos.SimulatedPreemption):
+                save_sharded(str(tmp_path), 5, new_fields,
+                             overwrite=True)
+        assert latest_committed_step(str(tmp_path)) == 5, point
+        back = load_checkpoint(p)  # the ORIGINAL bytes, every time
+        np.testing.assert_array_equal(
+            np.concatenate(back["params_shard"]),
+            np.concatenate(orig["params_shard"]), err_msg=point)
+    # without a kill the overwrite lands and the staging dir is gone
+    save_sharded(str(tmp_path), 5, new_fields, overwrite=True)
+    np.testing.assert_array_equal(
+        np.concatenate(load_checkpoint(p)["params_shard"]),
+        np.full(16, 9.0, np.float32))
+    assert not os.path.exists(p + ".tmp") and not os.path.exists(
+        p + ".old")
+    # ...and a committed step without overwrite=True is refused
+    with pytest.raises(S.CheckpointError, match="overwrite=True"):
+        save_sharded(str(tmp_path), 5, new_fields)
+
+
+def test_foreign_format_and_target_refused(tmp_path):
+    """The sharded writer refuses to clear a step directory holding
+    another format's artifacts (a legacy pickle/orbax checkpoint must
+    never be silently destroyed as 'aborted partials'), and the legacy
+    loader refuses target= on a manifest directory instead of silently
+    returning a raw field dict."""
+    legacy_dir = save_checkpoint(str(tmp_path), {"w": np.ones(4)},
+                                 step=5, use_orbax=False)
+    fields = {"step": ("replicated", np.asarray(5, np.int32))}
+    with pytest.raises(S.CheckpointError, match="another format"):
+        save_sharded(str(tmp_path), 5, fields)
+    assert os.path.exists(os.path.join(legacy_dir, "state.pkl"))
+
+    p = _toy_sharded(tmp_path / "sharded")
+    with pytest.raises(ValueError, match="restore_sharded"):
+        load_checkpoint(p, target={"anything": None})
+
+
+def test_interrupted_swap_recovers(tmp_path):
+    """A kill BETWEEN the overwrite swap's two renames strands the
+    step under .old/.tmp names the step scan skips — the discovery
+    path must heal it (prefer .tmp: it only commits after the new
+    save finished) instead of prune destroying the only copy."""
+    import shutil
+
+    p = _toy_sharded(tmp_path, step=5)
+    # simulate: old commit displaced to .old, new committed attempt
+    # still at .tmp, final name missing
+    shutil.move(p, p + ".old")
+    new_fields = {
+        "params_shard": ("sharded",
+                         list(np.split(np.full(16, 9.0, np.float32), 2))),
+        "step": ("replicated", np.asarray(5, np.int32))}
+    save_sharded(str(tmp_path), 5, new_fields)  # commits at final name
+    shutil.move(p, p + ".tmp")
+    assert not os.path.exists(p)
+    # discovery heals: the .tmp (newer) attempt wins
+    assert latest_committed_step(str(tmp_path)) == 5
+    np.testing.assert_array_equal(
+        np.concatenate(load_checkpoint(p)["params_shard"]),
+        np.full(16, 9.0, np.float32))
+    # the displaced .old next to a committed final is trash — prune
+    # clears it (and never touches the committed step)
+    S.prune(str(tmp_path), keep=1)
+    assert not os.path.exists(p + ".old")
+    assert latest_committed_step(str(tmp_path)) == 5
+
+    # .old alone (staging attempt was invalid/absent): also recovered
+    q = _toy_sharded(tmp_path / "b", step=9)
+    shutil.move(q, q + ".old")
+    assert latest_committed_step(str(tmp_path / "b")) == 9
+    assert os.path.exists(q)
+
+
+def test_restore_falls_back_past_crc_corruption(tmp_path):
+    """Size-preserving corruption in the NEWEST commit (the one case
+    the cheap commit scan can't see): restore(step=None) warns and
+    falls back to the next intact commit instead of aborting a resume
+    an older checkpoint could serve; an EXPLICIT step still raises."""
+    opt = FusedAdam(lr=1e-2)
+    state = opt.init({"w": jnp.ones((128,))})
+    mgr = CheckpointManager(str(tmp_path), opt, every_n_steps=1,
+                            keep=4, async_write=False)
+    mgr.save(4, state)
+    mgr.save(8, state)
+    # flip one byte of step 8's params at equal size
+    f = os.path.join(S.step_dir(str(tmp_path), 8), "params.bin")
+    raw = bytearray(open(f, "rb").read())
+    raw[0] ^= 0xFF
+    open(f, "wb").write(bytes(raw))
+
+    assert latest_committed_step(str(tmp_path)) == 8  # size sweep
+    with pytest.warns(UserWarning, match="falling back.*step 4"):
+        restored, _, manifest = mgr.restore()
+    assert manifest["step"] == 4
+    np.testing.assert_array_equal(np.asarray(restored.params),
+                                  np.asarray(state.params))
+    with pytest.raises(IncompleteCheckpointError, match="crc32"):
+        mgr.restore(step=8)
+
+
+def test_reshard_math_exact():
+    """The elastic re-layout is value-exact: dp=2×2-bucket → canonical
+    → dp=4×1-bucket → dp=3 and back reproduces the canonical buffer
+    bitwise (only zero padding moves), in fp32 AND bf16; incompatible
+    layouts (align / total / dtype) are refused."""
+    import ml_dtypes
+
+    for dt in (np.float32, ml_dtypes.bfloat16):
+        name = np.dtype(dt).name
+        canon = np.arange(24).astype(dt)
+        src = {"align": 1, "total": 24, "n_tensors": 4, "num_shards": 2,
+               "n_buckets": 2, "bucket_totals": [14, 10],
+               "bucket_padded": [16, 12], "master_dtype": name}
+        shards = list(np.split(S.relayout_flat(canon, src), 2))
+        np.testing.assert_array_equal(S.canonical_flat(shards, src),
+                                      canon)
+        for m, nb in ((4, 1), (3, 3), (1, 2)):
+            totals = {1: [24], 2: [14, 10], 3: [8, 8, 8]}[nb]
+            dst = {"align": 1, "total": 24, "n_tensors": 4,
+                   "num_shards": m, "n_buckets": nb,
+                   "bucket_totals": totals,
+                   "bucket_padded": [-(-t // m) * m for t in totals],
+                   "master_dtype": name}
+            g = S.reshard(shards, src, dst)
+            np.testing.assert_array_equal(
+                S.canonical_flat(list(np.split(g, m)), dst), canon)
+    bad = dict(src, align=128)
+    with pytest.raises(S.LayoutMismatchError, match="align"):
+        S.reshard(shards, src, bad)
+    bad = dict(src, master_dtype="float32", total=25)
+    with pytest.raises(S.LayoutMismatchError, match="total"):
+        S.reshard(shards, dict(src, master_dtype="float32"), bad)
+
+
+def test_manager_zero2_elastic_restore_bitwise():
+    """The manager end-to-end on REAL ZeRO-2 state (dp=2, 2 buckets):
+    equal-topology restore is bitwise on every shard buffer, and
+    dp=2→dp=1 / dp=2→dp=4 restores carry the SAME canonical values
+    (restore moves bytes, not arithmetic — cross-topology value
+    equality here is also bitwise; only the training arithmetic after
+    resume differs, which scripts/resume_probe.py gates)."""
+    import shutil
+    import tempfile
+
+    from apex_tpu.optimizers.distributed_fused_adam import (
+        DistributedFusedAdam,
+    )
+    from apex_tpu.parallel import mesh as M
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    params = {"w": jax.random.normal(jax.random.PRNGKey(0), (300, 4)),
+              "b": jax.random.normal(jax.random.PRNGKey(1), (11,))}
+
+    def build(dp):
+        M.destroy_model_parallel()
+        mesh = M.initialize_model_parallel(devices=jax.devices()[:dp])
+        opt = DistributedFusedAdam(num_shards=dp, lr=1e-3, n_buckets=2)
+        state = jax.jit(shard_map(
+            opt.init, mesh=mesh, in_specs=(P(),),
+            out_specs=opt.state_partition_specs(),
+            check_vma=False))(params)
+        return mesh, opt, state
+
+    mesh2, opt2, state2 = build(2)
+    # make the moments non-trivial so bitwise equality has teeth
+    g = {"w": jnp.full((300, 4), 1e-3), "b": jnp.full((11,), -2e-3)}
+    step_fn = jax.jit(shard_map(
+        lambda s, gg: opt2.step(s, gg)[1], mesh=mesh2,
+        in_specs=(opt2.state_partition_specs(), P()),
+        out_specs=opt2.state_partition_specs(), check_vma=False))
+    state2 = step_fn(state2, g)
+
+    tmp = tempfile.mkdtemp()
+    try:
+        mgr = CheckpointManager(tmp, opt2, every_n_steps=2)
+        assert not mgr.maybe_save(3, state2)   # off-cadence
+        assert mgr.maybe_save(4, state2)       # on-cadence
+        mgr.wait()
+        assert mgr.last_committed_step == 4
+        st = mgr.stats()
+        assert st["ckpt_last_step"] == 4 and st["ckpt_bytes"] > 0
+        assert st["ckpt_save_s"] >= 0 and st["ckpt_blocking_s"] >= 0
+
+        # equal topology: bitwise on every buffer
+        r2, _, _ = mgr.restore(mesh2)
+        for f in state2._fields:
+            np.testing.assert_array_equal(
+                np.asarray(getattr(r2, f)),
+                np.asarray(getattr(state2, f)), err_msg=f)
+
+        canon2 = S.canonical_flat(
+            list(np.split(np.asarray(state2.params_shard), 2)),
+            opt2.shard_layout())
+        # elastic: the same values land at dp=1 and dp=4
+        for dp in (1, 4):
+            meshd, optd, _ = build(dp)
+            mgrd = CheckpointManager(tmp, optd)
+            rd, _, manifest = mgrd.restore(meshd)
+            assert manifest["step"] == 4
+            canond = S.canonical_flat(
+                list(np.split(np.asarray(rd.params_shard), dp)),
+                optd.shard_layout())
+            np.testing.assert_array_equal(canond, canon2)
+            assert int(np.asarray(rd.step)) == int(
+                np.asarray(state2.step))
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+        M.destroy_model_parallel()
+
+
+def test_truncated_pickle_named_error(tmp_path):
+    """A short pickle (save killed mid-write) names itself instead of
+    surfacing an opaque deserialization traceback."""
+    import pickle
+
+    d = tmp_path / "pk"
+    os.makedirs(d)
+    raw = pickle.dumps({"w": np.arange(100.0)})
+    with open(d / "state.pkl", "wb") as f:
+        f.write(raw[: len(raw) // 2])
+    with pytest.raises(S.CheckpointError, match="truncated or corrupt"):
+        load_checkpoint(str(d))
+
+
+def test_metrics_logger_stamps_ckpt_fields(tmp_path):
+    """MetricsLogger(ckpt=manager) stamps the v6 ckpt_* cadence-pricing
+    scalars into every record once a save committed — and the record
+    still validates (OPTIONAL_SCHEMA)."""
+    from apex_tpu import monitor
+    from apex_tpu.monitor import logger as logger_lib
+
+    opt = FusedAdam(lr=1e-2)
+    state = opt.init({"w": jnp.ones((64,))})
+    mgr = CheckpointManager(str(tmp_path), opt, every_n_steps=1,
+                            async_write=False)
+
+    class _Probe:
+        def __init__(self):
+            self.records = []
+
+        def write(self, r):
+            self.records.append(dict(r))
+
+        def close(self):
+            pass
+
+    sink = _Probe()
+    logger = monitor.MetricsLogger([sink], ckpt=mgr)
+    metrics = monitor.init_metrics()._replace(
+        step=jnp.asarray(1, jnp.int32))
+    rec = logger.log_step(metrics)
+    assert "ckpt_last_step" not in rec          # nothing committed yet
+    mgr.maybe_save(1, state)
+    metrics = metrics._replace(step=jnp.asarray(2, jnp.int32))
+    rec = logger.log_step(metrics)
+    assert rec["ckpt_last_step"] == 1
+    assert rec["ckpt_bytes"] > 0
+    assert rec["ckpt_save_s"] >= 0 and rec["ckpt_blocking_s"] >= 0
+    logger_lib.validate_record(rec)
+
+
+def test_resume_guard_names_last_committed_step(tmp_path):
+    """Any exception under chaos.resume_guard dumps a flight report
+    whose reason names the last COMMITTED step — the crash artifact IS
+    the resume runbook (no recorder schema change)."""
+    import json
+
+    from apex_tpu import monitor
+
+    opt = FusedAdam(lr=1e-2)
+    state = opt.init({"w": jnp.ones((64,))})
+    mgr = CheckpointManager(str(tmp_path / "ck"), opt,
+                            every_n_steps=1, async_write=False)
+    mgr.save(41, state)
+    rec_path = tmp_path / "flight.json"
+    recorder = monitor.FlightRecorder(str(rec_path), capacity=4)
+    with pytest.raises(RuntimeError, match="boom"):
+        with chaos.resume_guard(recorder, mgr):
+            recorder.record(41, metrics=None)
+            raise RuntimeError("boom")
+    rep = json.loads(rec_path.read_text())
+    assert "last committed checkpoint: step 41" in rep["reason"]
+    from apex_tpu.monitor.trace import report as report_mod
+    report_mod.validate_report(rep)  # still schema-valid
+
+    # nothing committed: the guard says so instead of inventing a step
+    mgr2 = CheckpointManager(str(tmp_path / "empty"), opt)
+    rec2 = tmp_path / "flight2.json"
+    recorder2 = monitor.FlightRecorder(str(rec2), capacity=4)
+    with pytest.raises(chaos.SimulatedPreemption):
+        with chaos.resume_guard(recorder2, mgr2):
+            raise chaos.SimulatedPreemption("kill -9")
+    assert "NONE COMMITTED" in json.loads(rec2.read_text())["reason"]
+
+
+def test_lost_rank_watchdog_raises_instead_of_hanging(tmp_path):
+    """A persistently slow rank crosses the watchdog deadline and
+    raises RankLostError naming the rank, its skew, and the resume
+    point — the PR-4 straggler detector escalated from observation to
+    fault-tolerance (a hang becomes a crash dump + clean resume)."""
+    from apex_tpu.monitor.trace import StragglerDetector
+
+    opt = FusedAdam(lr=1e-2)
+    state = opt.init({"w": jnp.ones((64,))})
+    mgr = CheckpointManager(str(tmp_path), opt, every_n_steps=1,
+                            async_write=False)
+    mgr.save(40, state)
+
+    det = StragglerDetector(threshold=1.5, patience=2)
+    dog = chaos.LostRankWatchdog(det, manager=mgr, deadline=3)
+    base = np.full((4, 2), 0.1)
+    for _ in range(2):
+        dog.check(base)                 # balanced: no flags
+    slow = base.copy()
+    slow[2, 0] = 0.5                    # rank 2 goes dark-slow
+    dog.check(slow)
+    dog.check(slow)                     # flagged (patience 2) < deadline
+    with pytest.raises(chaos.RankLostError,
+                       match=r"rank 2 .*step 40"):
+        dog.check(slow)                 # 3rd consecutive = deadline
+
+
+def test_serve_engine_preempt_resume_bitwise(tmp_path):
+    """ISSUE 9 satellite: a serving node preempted MID-GENERATION
+    resumes without numeric drift.  The serve weight pytree AND the
+    engine state (paged KV pool, DecodeState, allocator, scheduler
+    queues) round-trip through save/load_checkpoint into a FRESH
+    engine, and the resumed streams finish with BITWISE the tokens of
+    the unpreempted run — whose tokens the PR-8 teacher-forced
+    fidelity test already pins to the training forward's argmax."""
+    from apex_tpu.models.gpt import GPT, GPTConfig
+    from apex_tpu.serve import DecodeEngine, ServeConfig
+
+    cfg = GPTConfig(vocab_size=64, seq_len=64, hidden=32, num_layers=2,
+                    num_heads=4, dropout=0.0)
+    sc = ServeConfig(n_slots=3, max_prompt_len=8, max_new_cap=8,
+                     page_size=4)
+    params = GPT(cfg).init(jax.random.PRNGKey(7))
+    params["pos_embed"] = params["pos_embed"] * 20.0  # varied decode
+    prompts = [[5, 9, 2, 17], [33, 1], [40, 41, 42]]
+    budgets = [6, 8, 5]
+
+    # unpreempted reference
+    eng1 = DecodeEngine(cfg, params, sc)
+    for p, b in zip(prompts, budgets):
+        eng1.submit(p, b)
+    ref = {f.request_id: f.tokens for f in eng1.run()}
+    assert any(len(set(t)) > 1 for t in ref.values()), \
+        "degenerate decode — test has no teeth"
+
+    # preempted run: snapshot mid-generation...
+    eng2 = DecodeEngine(cfg, params, sc)
+    for p, b in zip(prompts, budgets):
+        eng2.submit(p, b)
+    eng2.step()
+    eng2.step()
+    snap = eng2.state_dict()
+    # pickle format: the snapshot's scheduler queues are plain host
+    # containers the orbax pytree layout would mangle on a target-less
+    # restore
+    path = save_checkpoint(str(tmp_path / "serve"),
+                           {"params": params, "engine": snap}, step=2,
+                           use_orbax=False)
+    half = {f.request_id: f.tokens for f in eng2.poll()}
+    del eng2
+
+    # ...and resume into a FRESH engine from the checkpoint
+    restored = load_checkpoint(str(tmp_path / "serve"), step=2)
+    eng3 = DecodeEngine(cfg, restored["params"], sc)
+    eng3.load_state_dict(restored["engine"])
+    finished = dict(half)
+    finished.update(
+        {f.request_id: f.tokens for f in eng3.run()})
+    assert finished == ref
+    assert eng3.recompile_ok
+    assert eng3.cache.free_pages == eng1.cache.free_pages
+
+    # a snapshot from a DIFFERENT deployment is refused loudly
+    other = DecodeEngine(cfg, params, ServeConfig(
+        n_slots=2, max_prompt_len=8, max_new_cap=8, page_size=4))
+    with pytest.raises(ValueError, match="different deployment"):
+        other.load_state_dict(snap)
+
+
+def test_resume_probe_selftest():
+    """Tier-1 CI gate (mirrors lint_step/comms_probe/flight_report
+    --selftest): the committed manifest fixture still validates, the
+    reshard math round-trips bitwise, and the seeded truncated shard
+    is refused with its rank named."""
+    r = _run_script(ROOT / "scripts" / "resume_probe.py", "--selftest")
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "resume_probe --selftest: OK" in r.stdout
+
+
+def test_resume_probe_full_gate():
+    """The standing save→kill→restore→trajectory-match gate (ISSUE 9
+    acceptance): kill-mid-save leaves the last committed manifest
+    restorable, equal-topology preempt/resume reproduces the
+    unpreempted loss trajectory BITWISE, dp=2→dp=1 and dp=2→dp=4
+    resumes match allclose, and every resumed run shows zero
+    steady-state recompiles (RecompileSentry-enforced)."""
+    r = _run_script(ROOT / "scripts" / "resume_probe.py",
+                    "--steps", "6", "--save-at", "3", "--json")
+    assert r.returncode == 0, r.stdout + r.stderr
+    payload = None
+    for line in reversed(r.stdout.strip().splitlines()):
+        try:
+            d = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(d, dict) and "ok" in d:
+            payload = d
+            break
+    assert payload is not None, r.stdout
+    assert payload["ok"] is True
+    assert payload["equal_topology_bitwise"] is True
+    assert payload["dp1_allclose"] is True
+    assert payload["dp4_allclose"] is True
+    assert payload["last_committed_after_kill"] == 3
