@@ -61,7 +61,23 @@ from apex_tpu.monitor.sinks import MetricSink, ScalarWriter
 # CheckpointManager attached, or one attached before the first save,
 # simply doesn't stamp them), `ckpt_` prefix reserved for JSON
 # scalars like `comms_`/`serve_`.
-SCHEMA_VERSION = 6
+# v7 (ISSUE 10): the LIVE serving-observatory fields, stamped by
+# `MetricsLogger(serve=engine)` from the engine's request-lifecycle
+# ledger and gauges (serve/telemetry.py) — where the v5 fields quote
+# a finished `measure_decode` run, these quote the engine NOW.
+# Gauges (`serve_queue_depth` / `serve_slots_live` /
+# `serve_pages_free` / `serve_pool_util` / `serve_requests_retired` /
+# `serve_tokens_emitted`) stamp on every record; ledger percentiles
+# (`serve_ttft_p50_ms` / `serve_ttft_p99_ms` / `serve_token_p50_ms` /
+# `serve_token_p99_ms` / `serve_queue_wait_p99_ms` /
+# `serve_queue_wait_max_ms`) stamp once a request has retired;
+# `serve_slo_ok` stamps when the engine carries a ServeSLO AND the
+# verdict is grounded — a breach, or a green with every configured
+# axis measured (an idle engine's all-skipped "ok" is unmeasured and
+# is NOT stamped: a vacuous green would paint an outage window).  All
+# OPTIONAL, never-null when present (the v4 rule: no samples → no
+# field, never a null), same reserved `serve_` scalar prefix as v5.
+SCHEMA_VERSION = 7
 
 # field -> (python type, finite_required).  loss_scale may legitimately
 # be large but is finite; grad/update norms are inf/nan ON overflow
@@ -120,6 +136,24 @@ OPTIONAL_SCHEMA = {
     "ckpt_save_s": (float, False),
     "ckpt_last_step": (int, False),
     "ckpt_bytes": (int, False),
+    # v7 (ISSUE 10): the live serving observatory.  Gauges are always
+    # real values (a serving engine always has a queue depth);
+    # percentile fields appear only once the ledger has samples, and
+    # serve_slo_ok only when a ServeSLO is attached — never null.
+    "serve_queue_depth": (int, False),
+    "serve_slots_live": (int, False),
+    "serve_pages_free": (int, False),
+    "serve_pool_util": (float, False),       # instantaneous gauge
+    "serve_pool_util_peak": (float, False),  # run peak (bench stamp)
+    "serve_requests_retired": (int, False),
+    "serve_tokens_emitted": (int, False),
+    "serve_ttft_p50_ms": (float, False),
+    "serve_ttft_p99_ms": (float, False),
+    "serve_token_p50_ms": (float, False),
+    "serve_token_p99_ms": (float, False),
+    "serve_queue_wait_p99_ms": (float, False),
+    "serve_queue_wait_max_ms": (float, False),
+    "serve_slo_ok": (bool, False),
 }
 _OPTIONAL_PREFIXES = ("compile_", "hbm_", "comms_", "serve_", "ckpt_")
 
@@ -212,7 +246,8 @@ class MetricsLogger:
                  sentry=None,
                  memory: bool = False,
                  memory_device=None,
-                 ckpt=None):
+                 ckpt=None,
+                 serve=None):
         self.sinks = list(sinks)
         self.flops_per_step = flops_per_step
         # None resolves the per-chip peak from the device kind (ISSUE 5
@@ -238,6 +273,13 @@ class MetricsLogger:
         # JSONL stream shows what checkpointing cost next to the
         # step-time it may have inflated.
         self.ckpt = ckpt
+        # serve: a serve.DecodeEngine (anything with .serve_record())
+        # — every record gains the v7 `serve_*` live gauges and ledger
+        # percentiles (ISSUE 10), so "what is my TTFT p99 right now"
+        # reads out of the same JSONL stream as the training metrics.
+        # All host-side state the scheduler already owns: stamping
+        # adds zero device syncs.
+        self.serve = serve
         # taps=True: log_step(…, taps=tap_state) folds the flight
         # recorder's per-layer stat planes into each record as compact
         # summary fields (tap_fwd_absmax / tap_grad_absmax /
@@ -337,6 +379,8 @@ class MetricsLogger:
             record.update(_wm.hbm_watermarks(self.memory_device))
         if self.ckpt is not None:
             record.update(self.ckpt.stats())
+        if self.serve is not None:
+            record.update(self.serve.serve_record())
         if extra:
             record.update(extra)
         for s in self.sinks:

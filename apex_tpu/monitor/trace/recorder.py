@@ -23,6 +23,7 @@ FLIGHT_RECORDER_VERSION on any field add/rename/re-semantics):
      "compile_report": {...} | null,     # last attached CompileReport
      "compile_events": [{...}],          # RecompileSentry events
      "memory": {device_id: stats} | null,  # memory_stats at dump time
+     "serve": {...} | null,              # attach_serve telemetry_report
      "records": [{"step": int,
                   "metrics": {...} | null,   # flat MetricsLogger record
                   "taps": {...} | null,      # taps.taps_to_dict shape
@@ -39,6 +40,16 @@ budget table instead of a bare stack trace.  A report produced by
 static linter's verdict in its `lint` field — the crash dump then
 tells the lint story too, with no schema change here (the field rides
 inside compile_report).
+
+The serving plane (ISSUE 10) rides the same no-schema-change
+attachment pattern: `attach_serve(engine)` keeps a reference to a
+`serve.DecodeEngine` (or anything with `telemetry_report()`, or a
+plain dict) and the dump materializes its request-lifecycle ledger
+tail + gauges + engine stats under a `serve` key — an ADDITIVE
+optional field (`validate_report` tolerates extras, like the lint
+verdict above), so v2 reports from older builds still render.  A
+serving crash then dies with its last N requests' lifecycle stamps
+next to the compile events, instead of a bare stack trace.
 
 Non-finite floats (an overflow step's absmax is ±inf by construction)
 are serialized through `sinks.sanitize_json_floats` — the report is
@@ -97,6 +108,10 @@ class FlightRecorder:
         self._compile_report = None
         self._compile_events = collections.deque(
             maxlen=_MAX_COMPILE_EVENTS)
+        # the serving plane (ISSUE 10): a live source, resolved at
+        # dump time so the crash artifact carries the ledger tail AS
+        # OF the crash, not as of attachment
+        self._serve_source = None
 
     def __len__(self) -> int:
         return len(self._ring)
@@ -108,6 +123,28 @@ class FlightRecorder:
         if hasattr(report, "to_dict"):
             report = report.to_dict()
         self._compile_report = report
+
+    def attach_serve(self, source) -> None:
+        """Attach the serving observatory (ISSUE 10): `source` is a
+        `serve.DecodeEngine` — anything with `telemetry_report()` —
+        or an already-materialized dict.  The report gains a `serve`
+        key holding the request-lifecycle ledger tail, gauges/peaks,
+        and engine stats, resolved AT DUMP TIME (a crash dumps the
+        requests that were actually in flight).  Additive-optional:
+        no recorder version bump, old reports still validate
+        (the lint-inside-compile_report precedent)."""
+        self._serve_source = source
+
+    def _serve_report(self):
+        src = self._serve_source
+        if src is None:
+            return None
+        try:
+            if hasattr(src, "telemetry_report"):
+                return src.telemetry_report()
+            return dict(src)
+        except Exception as e:  # pragma: no cover — a poisoned engine
+            return {"fetch_error": repr(e)}  # must not cost the report
 
     def note_compile_event(self, event: dict) -> None:
         """Record one sentry compile event (bounded list; the
@@ -187,6 +224,7 @@ class FlightRecorder:
             "compile_report": self._compile_report,
             "compile_events": list(self._compile_events),
             "memory": memory,
+            "serve": self._serve_report(),
             "records": records,
         }
 

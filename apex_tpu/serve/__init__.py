@@ -16,9 +16,17 @@ Three layers, bottom-up:
     a RecompileSentry enforces that steady-state churn never
     retraces.
 
+  * serve/telemetry.py — the serving observatory (ISSUE 10): a
+    request-lifecycle ledger (submit → admit → first-token → retire,
+    host-stamped, zero extra device syncs), bounded-memory streaming
+    percentiles for live TTFT / queue-wait / per-token latency,
+    queue/pool gauges, and the `ServeSLO` verdict that
+    `scripts/slo_probe.py` gates in CI.
+
 docs/serving.md is the operator guide; examples/serve_gpt.py the
 runnable entry point; bench.py stamps `serve_*` decode-throughput and
-latency axes.
+latency axes; docs/observability.md § "Reading the serving plane"
+documents the live stamps.
 """
 
 from apex_tpu.ops.flash_decode import (  # noqa: F401
@@ -39,4 +47,16 @@ from apex_tpu.serve.kv_cache import (  # noqa: F401
     PagedKVCache,
     default_page_size,
     gather_slot,
+)
+from apex_tpu.serve.telemetry import (  # noqa: F401
+    SERVE_TELEMETRY_VERSION,
+    RequestLedger,
+    RequestRecord,
+    ServeSLO,
+    ServeTelemetry,
+    SLOBreach,
+    SLOVerdict,
+    StreamingPercentiles,
+    step_latency_percentiles,
+    validate_serve_report,
 )

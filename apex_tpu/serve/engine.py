@@ -61,6 +61,8 @@ from apex_tpu.ops.flash_decode import flash_decode
 from apex_tpu.ops.layer_norm import fused_layer_norm
 from apex_tpu.serve.kv_cache import (TRASH_PAGE, KVCacheConfig,
                                      PagedKVCache, default_page_size)
+from apex_tpu.serve.telemetry import (ServeTelemetry,
+                                      step_latency_percentiles)
 
 _NEG_INF = -1e30
 
@@ -161,7 +163,7 @@ class DecodeEngine:
     """
 
     def __init__(self, model_cfg, params, serve_cfg: ServeConfig,
-                 recorder=None):
+                 recorder=None, telemetry=True, slo=None):
         c, s = model_cfg, serve_cfg
         if c.hidden % c.num_heads:
             raise ValueError(
@@ -219,6 +221,26 @@ class DecodeEngine:
         self._free_slots = list(range(ns - 1, -1, -1))
         self._live: Dict[int, tuple] = {}      # slot -> (rid, prompt)
         self._finished: List[FinishedRequest] = []
+
+        # serving observatory (ISSUE 10): the request-lifecycle ledger
+        # + gauges.  Pure host bookkeeping — the compiled decode step
+        # and its outputs are bitwise identical telemetry on vs off
+        # (slo_probe enforces it).  telemetry= accepts True (default
+        # ServeTelemetry), a ServeTelemetry instance (custom caps), or
+        # False/None (off).  slo= is an optional ServeSLO whose
+        # verdict `serve_record()` stamps as `serve_slo_ok`.
+        if telemetry is True:
+            telemetry = ServeTelemetry()
+        self.telemetry = telemetry or None
+        self.slo = slo
+        # requests admitted since the last retire poll: their prefill/
+        # decode is bounded by the NEXT poll's device fetch, which is
+        # where their first-token stamp is taken (telemetry module
+        # docstring — the zero-extra-syncs timestamp discipline)
+        self._awaiting_first: List[int] = []
+        if (recorder is not None and self.telemetry is not None
+                and hasattr(recorder, "attach_serve")):
+            recorder.attach_serve(self)
 
     # ------------------------------------------------------------------
     # model forward pieces (mirror models.gpt.GPT._block op-for-op)
@@ -434,6 +456,10 @@ class DecodeEngine:
         rid = self._next_rid
         self._next_rid += 1
         self._pending.append((rid, prompt, int(max_new_tokens)))
+        if self.telemetry is not None:
+            self.telemetry.ledger.on_submit(rid, len(prompt),
+                                            int(max_new_tokens),
+                                            time.perf_counter())
         return rid
 
     def _try_admit(self) -> int:
@@ -450,6 +476,14 @@ class DecodeEngine:
             self._pending.popleft()
             self._free_slots.pop()
             self._live[slot] = (rid, prompt)
+            # admit stamp = the scheduler's decision moment, BEFORE
+            # the prefill dispatch: queue wait measures time in the
+            # queue, not the admitting prefill's (possibly compiling)
+            # dispatch — that cost lands in TTFT, where it belongs
+            if self.telemetry is not None:
+                self.telemetry.ledger.on_admit(rid, slot,
+                                               time.perf_counter())
+                self._awaiting_first.append(rid)
             self.state = self.state._replace(
                 block_table=self.cache.device_table())
             padded = np.zeros((self.serve_cfg.max_prompt_len,), np.int32)
@@ -469,6 +503,17 @@ class DecodeEngine:
         if not self._live:
             return 0
         done = np.asarray(self.state.done)
+        # ^ that fetch is the engine's steady-state sync point: it
+        # blocks until every previously dispatched step (the admitting
+        # prefills and their decode included) has materialized — so
+        # the host clock NOW bounds the device-side truth, and the
+        # lifecycle stamps below cost no extra sync (ISSUE 10).
+        if self.telemetry is not None:
+            now = time.perf_counter()
+            if self._awaiting_first:
+                self.telemetry.ledger.on_first_token(
+                    self._awaiting_first, now)
+                self._awaiting_first = []
         if not done.any():
             return 0
         n_gen = np.asarray(self.state.n_generated)
@@ -485,6 +530,8 @@ class DecodeEngine:
             self._finished.append(
                 FinishedRequest(request_id=rid, prompt=prompt,
                                 tokens=toks))
+            if self.telemetry is not None:
+                self.telemetry.ledger.on_retire(rid, n, now)
             self.cache.release_slot(slot)
             self._free_slots.append(slot)
             to_clear.append(slot)
@@ -509,6 +556,8 @@ class DecodeEngine:
             # empty grid — submit() rejected anything that can't):
             # skip the all-inactive decode forward the final retire
             # would otherwise pay for nothing
+            if self.telemetry is not None:
+                self.telemetry.note_step(admitted, retired, self.gauges())
             return admitted, retired
         out = self.sentry(self.params, self.kv, self.state)
         if self.serve_cfg.emit_logits:
@@ -529,6 +578,8 @@ class DecodeEngine:
                     or self.sentry.calls >= _STEADY_WARMUP_CAP):
                 self.sentry.mark_steady()
                 self._steady = True
+        if self.telemetry is not None:
+            self.telemetry.note_step(admitted, retired, self.gauges())
         return admitted, retired
 
     def run(self, max_steps: int = 10_000) -> List[FinishedRequest]:
@@ -559,6 +610,66 @@ class DecodeEngine:
             "recompile_ok": self.recompile_ok,
             "sentry": self.sentry.summary(),
         }
+
+    # ------------------------------------------------------------------
+    # serving observatory readers (ISSUE 10)
+    # ------------------------------------------------------------------
+
+    def gauges(self) -> dict:
+        """Instantaneous scheduler/pool gauges — all host-side values
+        the scheduler already owns, zero device traffic."""
+        cfg = self.kv_config
+        used = cfg.usable_pages - self.cache.free_pages
+        return {
+            "slots_live": len(self._live),
+            "slots_free": len(self._free_slots),
+            "queue_depth": len(self._pending),
+            "pages_free": self.cache.free_pages,
+            "pages_used": used,
+            "pool_util": used / max(1, cfg.usable_pages),
+        }
+
+    def serve_record(self) -> dict:
+        """Flat `serve_*` JSON scalars for `MetricsLogger(serve=eng)`
+        (SCHEMA v7): live gauges always, ledger percentiles once
+        samples exist, `serve_slo_ok` when an SLO is attached."""
+        if self.telemetry is None:
+            return {}
+        rec = self.telemetry.serve_record()
+        if self.slo is not None:
+            v = self.slo_verdict()
+            # only GROUNDED verdicts stamp: a breach always does; a
+            # green does only once every configured axis has samples.
+            # A fresh/idle engine's all-skipped "ok" is unmeasured,
+            # and stamping it would paint an outage window green.
+            if v.grounded:
+                rec["serve_slo_ok"] = bool(v.ok)
+        return rec
+
+    def slo_verdict(self, slo=None):
+        """Evaluate `slo` (default: the engine's attached ServeSLO)
+        against the live telemetry — the breach report names the
+        violated axis and the offending percentile."""
+        slo = slo if slo is not None else self.slo
+        if slo is None:
+            raise ValueError("slo_verdict: no ServeSLO attached or given")
+        if self.telemetry is None:
+            raise ValueError("slo_verdict: engine built telemetry=False")
+        return slo.evaluate(self.telemetry)
+
+    def telemetry_report(self) -> Optional[dict]:
+        """The full JSON-safe observatory dict (ledger summary + tail,
+        gauges/peaks, step counters, engine stats, SLO verdict when
+        attached) — what `FlightRecorder.attach_serve` pulls into a
+        crash dump and what `scripts/slo_probe.py` validates."""
+        if self.telemetry is None:
+            return None
+        rep = self.telemetry.report()
+        rep["stats"] = self.stats()
+        if self.slo is not None:
+            rep["slo"] = self.slo.to_dict()
+            rep["slo_verdict"] = self.slo_verdict().to_dict()
+        return rep
 
     # ------------------------------------------------------------------
     # checkpoint / preemption resume (ISSUE 9)
@@ -652,6 +763,33 @@ class DecodeEngine:
             FinishedRequest(request_id=int(rid), prompt=[int(t) for t in p],
                             tokens=[int(t) for t in toks])
             for rid, p, toks in sch["finished"]]
+        # the ledger is RESTORE-scoped (monotonic stamps die with the
+        # process; it is deliberately not in the snapshot): the
+        # telemetry is rebuilt FRESH — an in-place rollback on a
+        # non-fresh engine would otherwise double-count rids already
+        # submitted and strand open records of requests absent from
+        # the snapshot, breaking the submitted==admitted==retired
+        # reconciliation forever — and the restored requests are then
+        # re-registered so retire events keep reconciling: queued ones
+        # as fresh submissions (queue wait from the restore point is
+        # real), in-flight ones marked `restored` so they count in
+        # totals without feeding resume-relative deltas into the
+        # latency estimators
+        self._awaiting_first = []
+        if self.telemetry is not None:
+            old = self.telemetry
+            self.telemetry = ServeTelemetry(
+                tail_cap=old.ledger.tail.maxlen,
+                estimator_capacity=old.ledger.ttft.capacity,
+                step_time_warmup=old._step_time_warmup)
+            now = time.perf_counter()
+            led = self.telemetry.ledger
+            for rid, p, mn in self._pending:
+                led.reopen_restored(rid, len(p), mn, now)
+            max_new = np.asarray(self.state.max_new)
+            for slot, (rid, p) in self._live.items():
+                led.reopen_restored(rid, len(p), int(max_new[slot]),
+                                    now, slot=slot)
 
 
 def measure_decode(eng: DecodeEngine, *, warm: int = 2,
@@ -680,7 +818,19 @@ def measure_decode(eng: DecodeEngine, *, warm: int = 2,
                       pure_decode_steps == 0 marks the degenerate
                       all-churn window where they fall back, with a
                       warning, to every post-warmup step
+      admitted / retired  summed step() accounting (what slo_probe
+                      reconciles the ledger against)
+      ledger          the engine's ledger summary (None when the
+                      engine was built telemetry=False)
       recompile_ok    the sentry verdict
+
+    ISSUE 10 re-expressed the percentile math over the ledger's
+    module: `telemetry.step_latency_percentiles` is the ONE
+    implementation (live telemetry's `step_lat` estimator applies the
+    same exclusions), and each synced per-step duration is recorded
+    into the engine's telemetry so a live reader sees the same
+    convention this function returns (the regression test pins new
+    p50/p99 == old on identical recorded durations).
     """
     if not eng.pending:
         raise ValueError("measure_decode: engine has no pending "
@@ -688,6 +838,7 @@ def measure_decode(eng: DecodeEngine, *, warm: int = 2,
     per_step, churn, cum_tokens = [], [], []
     finished: List[FinishedRequest] = []
     polled_tokens = 0
+    n_admitted = n_retired = 0
     while eng.pending:
         if max_steps is not None and len(per_step) >= max_steps:
             raise RuntimeError(
@@ -696,8 +847,14 @@ def measure_decode(eng: DecodeEngine, *, warm: int = 2,
         t0 = time.perf_counter()
         admitted, retired = eng.step()
         jax.block_until_ready(eng.state)
-        per_step.append(time.perf_counter() - t0)
-        churn.append(bool(admitted or retired))
+        dt = time.perf_counter() - t0
+        per_step.append(dt)
+        churned = bool(admitted or retired)
+        churn.append(churned)
+        n_admitted += admitted
+        n_retired += retired
+        if eng.telemetry is not None:
+            eng.telemetry.record_step_time(dt, churned, warmup=warm)
         fins = eng.poll()
         finished.extend(fins)
         polled_tokens += sum(len(f.tokens) for f in fins)
@@ -705,13 +862,13 @@ def measure_decode(eng: DecodeEngine, *, warm: int = 2,
             polled_tokens + int(np.asarray(eng.state.n_generated).sum()))
     # the last step retires the final cohort at ITS start; drain any
     # stragglers the loop exit left unpolled
-    eng._retire_finished()
+    n_retired += eng._retire_finished()
     finished.extend(eng.poll())
     w = min(warm, len(per_step) - 1)        # w <= len-1: never empty
     window = per_step[w:]
     win_tokens = int(np.diff([0] + cum_tokens)[w:].sum())
-    pure = [t for t, c in zip(window, churn[w:]) if not c]
-    if not pure:
+    pct = step_latency_percentiles(per_step, churn, warm=warm)
+    if not pct["pure_decode_steps"]:
         # every post-warmup step churned — the percentiles below are
         # churn-contaminated, LOUDLY (pure_decode_steps == 0 marks the
         # record; a silent fallback would stamp prefill bursts as
@@ -721,16 +878,20 @@ def measure_decode(eng: DecodeEngine, *, warm: int = 2,
             "measure_decode: no pure decode step in the measurement "
             "window; p50/p99 include admission/retirement work",
             stacklevel=2)
-    decode_only = pure or window
     return {
         "finished": finished,
         "per_step_s": per_step,
+        "churn": churn,
         "steps": len(per_step),
         "churn_steps": int(sum(churn)),
-        "pure_decode_steps": len(pure),
+        "pure_decode_steps": pct["pure_decode_steps"],
         "tokens_per_sec": win_tokens / sum(window),
-        "p50_ms": 1e3 * float(np.percentile(decode_only, 50)),
-        "p99_ms": 1e3 * float(np.percentile(decode_only, 99)),
+        "p50_ms": pct["p50_ms"],
+        "p99_ms": pct["p99_ms"],
+        "admitted": n_admitted,
+        "retired": n_retired,
+        "ledger": (eng.telemetry.ledger.summary()
+                   if eng.telemetry is not None else None),
         "recompile_ok": eng.recompile_ok,
     }
 

@@ -507,7 +507,7 @@ def _serve_decode_bench(on_tpu):
             eng.submit(rng.randint(
                 0, eng.model_cfg.vocab_size, plen).tolist(), max_new)
         m = measure_decode(eng, max_steps=16 * max_new + 64)
-        sweep[str(n)] = {
+        entry = {
             "tokens_per_sec": round(m["tokens_per_sec"], 1),
             "p50_ms": round(m["p50_ms"], 3),
             "p99_ms": round(m["p99_ms"], 3),
@@ -515,6 +515,29 @@ def _serve_decode_bench(on_tpu):
             "churn_steps": m["churn_steps"],
             "recompile_ok": m["recompile_ok"],
         }
+        # the request-lifecycle ledger summary (ISSUE 10): per-level
+        # TTFT / queue-wait / per-token percentiles + pool/queue peaks
+        # ride under the unreserved `serving` dict; _stamp_serve lifts
+        # the largest-N scalars into the flat v7 `serve_*` fields
+        if eng.telemetry is not None:
+            led = eng.telemetry.ledger
+
+            def ms(v):
+                return None if v is None else round(1e3 * v, 3)
+            entry["ledger"] = {
+                "requests": led.n_retired,
+                "tokens": led.tokens_emitted,
+                "ttft_p50_ms": ms(led.ttft.percentile(50.0)),
+                "ttft_p99_ms": ms(led.ttft.percentile(99.0)),
+                "token_p50_ms": ms(led.token_lat.percentile(50.0)),
+                "token_p99_ms": ms(led.token_lat.percentile(99.0)),
+                "queue_wait_p99_ms": ms(led.queue_wait.percentile(99.0)),
+                "queue_wait_max_ms": ms(led.queue_wait.max),
+                "pool_util_peak": round(
+                    eng.telemetry.peaks["pool_util"], 4),
+                "queue_depth_peak": eng.telemetry.peaks["queue_depth"],
+            }
+        sweep[str(n)] = entry
     return sweep
 
 
@@ -535,6 +558,21 @@ def _stamp_serve(result, sweep):
     result["serve_p99_ms"] = float(top["p99_ms"])
     result["serve_recompile_ok"] = all(
         v["recompile_ok"] for v in sweep.values())
+    # v7 (ISSUE 10): the largest-N ledger scalars — TTFT percentiles,
+    # queue-wait p99, and the run's PEAK pool utilization.  The peak
+    # gets its OWN field (`serve_pool_util_peak`): the live logger
+    # stamps `serve_pool_util` as an instantaneous gauge, and one
+    # field must not carry two semantics (the re-semanticize rule,
+    # docs/observability.md).  Optional-never-null: a sweep without
+    # ledger data (telemetry off) simply doesn't stamp them.
+    led = top.get("ledger") or {}
+    for src, dst in (("ttft_p50_ms", "serve_ttft_p50_ms"),
+                     ("ttft_p99_ms", "serve_ttft_p99_ms"),
+                     ("queue_wait_p99_ms", "serve_queue_wait_p99_ms"),
+                     ("pool_util_peak", "serve_pool_util_peak")):
+        v = led.get(src)
+        if v is not None:
+            result[dst] = float(v)
 
 
 def _ckpt_cycle(on_tpu):

@@ -11,6 +11,14 @@ usage:
   python examples/serve_gpt.py                       # 64 streams
   python examples/serve_gpt.py --streams 256 --max-new 32
   python examples/serve_gpt.py --force-cpu-devices 1 # CPU smoke
+  python examples/serve_gpt.py --slo-ttft-p99-ms 500 \
+      --slo-token-p99-ms 50        # exit nonzero on an SLO breach
+
+Besides the recompile gate, the run prints the request-lifecycle
+ledger summary (TTFT / queue-wait percentiles, pool-utilization peak
+— apex_tpu.serve.telemetry, ISSUE 10) and, when `--slo-*` thresholds
+are given, exits nonzero on a `ServeSLO` breach verdict with the
+violated axis named — the same posture as the sentry trip.
 
 On a CPU backend the smoke-size model substitutes through the same
 build path (`serve.build_flagship_engine`) — shapes shrink, the
@@ -37,6 +45,12 @@ def main():
     ap.add_argument("--slots", type=int, default=None,
                     help="engine slots (default: min(streams, 64) — "
                          "fewer slots than streams exercises queueing)")
+    ap.add_argument("--slo-ttft-p99-ms", type=float, default=None,
+                    help="fail (exit nonzero) if the ledger's TTFT "
+                         "p99 exceeds this many ms")
+    ap.add_argument("--slo-token-p99-ms", type=float, default=None,
+                    help="fail (exit nonzero) if the per-token p99 "
+                         "exceeds this many ms")
     ap.add_argument("--force-cpu-devices", type=int, default=0,
                     help="emulate N CPU devices (consumed by "
                          "_bootstrap before jax init)")
@@ -47,7 +61,8 @@ def main():
     import jax
     import numpy as np
 
-    from apex_tpu.serve import build_flagship_engine, measure_decode
+    from apex_tpu.serve import (ServeSLO, build_flagship_engine,
+                                measure_decode)
 
     on_tpu = jax.default_backend() not in ("cpu",)
     n_slots = args.slots or min(args.streams, 64)
@@ -102,6 +117,28 @@ def main():
         print(f"FAIL: {args.streams - len(finished)} request(s) never "
               "retired", file=sys.stderr)
         return 1
+
+    # the serving observatory (ISSUE 10): the request-lifecycle
+    # ledger's live percentiles, and — when an SLO is given — the
+    # verdict as an exit code (same posture as the sentry trip above:
+    # CI holds the latency contract, not just the throughput print)
+    led = eng.telemetry.ledger
+    print(f"ledger: {led.n_retired} retired / {led.tokens_emitted} "
+          f"tokens | ttft p50 {1e3 * led.ttft.percentile(50):.1f} ms "
+          f"p99 {1e3 * led.ttft.percentile(99):.1f} ms | queue-wait "
+          f"p99 {1e3 * led.queue_wait.percentile(99):.1f} ms | pool "
+          f"util peak {eng.telemetry.peaks['pool_util']:.2f}")
+    if (args.slo_ttft_p99_ms is not None
+            or args.slo_token_p99_ms is not None):
+        slo = ServeSLO(ttft_p99_ms=args.slo_ttft_p99_ms,
+                       per_token_p99_ms=args.slo_token_p99_ms)
+        verdict = eng.slo_verdict(slo)
+        print(verdict.describe())
+        if not verdict.ok:
+            print("FAIL: serve SLO breach (axes: "
+                  + ", ".join(b.axis for b in verdict.breaches) + ")",
+                  file=sys.stderr)
+            return 1
     print("serve_gpt: OK (zero steady-state recompiles)")
     return 0
 

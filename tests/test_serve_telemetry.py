@@ -1,0 +1,592 @@
+"""Serving observatory (ISSUE 10): the streaming percentile estimator
+vs the NumPy oracle (exact below reservoir capacity, tolerance above,
+tiny-sample edges), request-lifecycle ledger exactness under a
+hand-tracked churn schedule (head-of-line queue waits included), the
+re-expressed `measure_decode` pinned to the old percentile math, the
+SCHEMA v7 `serve_*` stamps through `MetricsLogger(serve=engine)`,
+crash-dump ledger attachment validity, SLO verdicts naming the
+violated axis, and the `scripts/slo_probe.py` CI gates."""
+
+import json
+import os
+import pathlib
+import subprocess
+import sys
+
+import jax
+import numpy as np
+import pytest
+
+from apex_tpu import monitor
+from apex_tpu.models.gpt import GPT, GPTConfig
+from apex_tpu.serve import (
+    DecodeEngine,
+    ServeConfig,
+    ServeSLO,
+    StreamingPercentiles,
+    measure_decode,
+    step_latency_percentiles,
+    validate_serve_report,
+)
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+
+_CFG = GPTConfig(vocab_size=64, seq_len=64, hidden=32, num_layers=2,
+                 num_heads=4, dropout=0.0)
+_SC = ServeConfig(n_slots=3, max_prompt_len=8, max_new_cap=8,
+                  page_size=4)
+
+
+def _params(seed=7, spread=20.0):
+    params = GPT(_CFG).init(jax.random.PRNGKey(seed))
+    params["pos_embed"] = params["pos_embed"] * spread
+    return params
+
+
+def _run_script(path, *args, timeout=600):
+    return subprocess.run(
+        [sys.executable, str(path), *args], capture_output=True,
+        text=True, timeout=timeout,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+
+
+# ------------------------------------------------------------------
+# streaming percentile estimator vs the NumPy oracle
+# ------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("dist", ["uniform", "lognormal", "normal",
+                                  "constant", "bimodal"])
+def test_estimator_exact_below_capacity(dist):
+    """Below reservoir capacity the estimator retains EVERY sample, so
+    its percentiles must equal np.percentile exactly (same linear
+    interpolation) — across distribution shapes."""
+    rng = np.random.RandomState(0)
+    xs = {
+        "uniform": rng.rand(300),
+        "lognormal": rng.lognormal(0.0, 2.0, 300),
+        "normal": rng.randn(300),
+        "constant": np.full(300, 3.25),
+        "bimodal": np.concatenate([rng.randn(150), 100 + rng.randn(150)]),
+    }[dist]
+    est = StreamingPercentiles(capacity=4096, seed=0)
+    est.extend(xs)
+    for q in (0.0, 25.0, 50.0, 95.0, 99.0, 100.0):
+        want = float(np.percentile(xs, q))
+        got = est.percentile(q)
+        np.testing.assert_allclose(got, want, rtol=1e-12, atol=1e-12,
+                                   err_msg=f"{dist} p{q}")
+    assert est.n == len(xs)
+    np.testing.assert_allclose(est.mean, xs.mean(), rtol=1e-12)
+    assert est.min == xs.min() and est.max == xs.max()
+
+
+def test_estimator_tiny_sample_edges():
+    est = StreamingPercentiles(capacity=16, seed=0)
+    assert est.percentile(50.0) is None          # empty: no samples,
+    assert est.mean is None and est.max is None  # never a fake zero
+    s = est.summary()
+    assert s["n"] == 0 and s["p99"] is None
+
+    est.add(4.0)                                 # one sample: every q
+    for q in (0.0, 50.0, 100.0):                 # IS that sample
+        assert est.percentile(q) == 4.0
+    for n in (2, 3, 5):                          # tiny n: exact oracle
+        e = StreamingPercentiles(capacity=16, seed=0)
+        xs = np.arange(n, dtype=float) * 1.5
+        e.extend(xs)
+        for q in (10.0, 50.0, 99.0):
+            np.testing.assert_allclose(
+                e.percentile(q), float(np.percentile(xs, q)),
+                rtol=1e-12)
+
+    with pytest.raises(ValueError, match="non-finite"):
+        est.add(float("nan"))
+    with pytest.raises(ValueError, match="not in"):
+        est.percentile(101.0)
+    with pytest.raises(ValueError, match="capacity"):
+        StreamingPercentiles(capacity=0)
+
+
+def test_estimator_reservoir_beyond_capacity():
+    """Above capacity: lifetime counters stay exact, percentile
+    estimates stay tolerance-close to the oracle, memory stays
+    bounded, and the eviction pattern is deterministic (seeded)."""
+    rng = np.random.RandomState(42)
+    xs = rng.lognormal(0.0, 1.0, 30_000)
+    a = StreamingPercentiles(capacity=1024, seed=0)
+    b = StreamingPercentiles(capacity=1024, seed=0)
+    for x in xs:
+        a.add(x)
+        b.add(x)
+    assert a.n == len(xs) and len(a._buf) == 1024
+    np.testing.assert_allclose(a.mean, xs.mean(), rtol=1e-12)
+    assert a.max == xs.max() and a.min == xs.min()   # exact extremes
+    assert abs(a.percentile(50.0) - np.percentile(xs, 50)) \
+        / np.percentile(xs, 50) < 0.15
+    assert abs(a.percentile(99.0) - np.percentile(xs, 99)) \
+        / np.percentile(xs, 99) < 0.35
+    # determinism: same seed + same stream -> identical estimate
+    assert a.percentile(99.0) == b.percentile(99.0)
+
+
+# ------------------------------------------------------------------
+# measure_decode re-expression: regression pin vs the old math
+# ------------------------------------------------------------------
+
+
+def test_step_latency_percentiles_pins_old_measure_decode_math():
+    """The satellite regression gate: `step_latency_percentiles` must
+    reproduce the percentile math previously inlined in
+    `measure_decode` — on identical recorded step durations — for
+    normal, all-churn-fallback, and short-window cases."""
+    rng = np.random.RandomState(3)
+    cases = [
+        (list(rng.rand(40) * 1e-2), list(rng.rand(40) < 0.3), 2),
+        (list(rng.rand(5) * 1e-3), [True, False, True, False, False], 2),
+        ([0.5, 0.01], [True, True], 2),          # all-churn fallback
+        ([0.7], [True], 2),                      # single step
+        (list(rng.rand(10)), [False] * 10, 5),   # custom warm
+    ]
+    for per_step, churn, warm in cases:
+        # the pre-ISSUE-10 implementation, verbatim
+        w = min(warm, len(per_step) - 1)
+        window = per_step[w:]
+        pure = [t for t, c in zip(window, churn[w:]) if not c]
+        decode_only = pure or window
+        want_p50 = 1e3 * float(np.percentile(decode_only, 50))
+        want_p99 = 1e3 * float(np.percentile(decode_only, 99))
+
+        got = step_latency_percentiles(per_step, churn, warm=warm)
+        assert got["p50_ms"] == want_p50 and got["p99_ms"] == want_p99
+        assert got["pure_decode_steps"] == len(pure)
+        assert got["window_steps"] == len(window)
+
+    with pytest.raises(ValueError, match="no steps"):
+        step_latency_percentiles([], [])
+    with pytest.raises(ValueError, match="churn flags"):
+        step_latency_percentiles([0.1, 0.2], [True])
+
+
+def test_measure_decode_quotes_shared_convention_and_ledger():
+    """measure_decode's returned p50/p99 must equal
+    step_latency_percentiles over its own per_step_s/churn record, and
+    its new admitted/retired/ledger keys must reconcile."""
+    params = _params(seed=11)
+    eng = DecodeEngine(_CFG, params, _SC)
+    budgets = [3, 5, 2, 4, 6]
+    for i, b in enumerate(budgets):
+        eng.submit([i + 1, i + 2], b)
+    m = measure_decode(eng)
+    pct = step_latency_percentiles(m["per_step_s"], m["churn"], warm=2)
+    assert m["p50_ms"] == pct["p50_ms"]
+    assert m["p99_ms"] == pct["p99_ms"]
+    assert m["pure_decode_steps"] == pct["pure_decode_steps"]
+    assert m["admitted"] == m["retired"] == len(budgets)
+    assert m["ledger"]["n_retired"] == len(budgets)
+    assert m["ledger"]["tokens_emitted"] == sum(budgets)
+    # the live step-time estimator saw the same pure decode steps
+    assert eng.telemetry.step_lat.n == m["pure_decode_steps"] or \
+        eng.telemetry.step_lat.n == 0  # (all-churn tiny runs)
+
+
+# ------------------------------------------------------------------
+# ledger accounting under a hand-tracked churn schedule
+# ------------------------------------------------------------------
+
+
+def test_ledger_accounting_exact_vs_hand_tracked_churn():
+    """Drive 8 ragged requests through 3 slots STEP BY STEP, tracking
+    the engine's (admitted, retired) returns by hand: the ledger's
+    counters must move in lockstep, every lifecycle is causally
+    ordered, per-request token counts match what poll() returned, and
+    head-of-line-blocked requests carry strictly positive queue wait
+    while the first-admitted cohort's is (near) zero."""
+    params = _params(seed=11)
+    eng = DecodeEngine(_CFG, params, _SC)          # 3 slots
+    prompts = [[1, 2], [3, 4, 5], [7], [9, 10, 11, 12], [13, 14],
+               [15, 16, 17, 18, 19], [21], [22, 23]]
+    budgets = [4, 6, 3, 5, 8, 2, 7, 4]
+    rids = [eng.submit(p, b) for p, b in zip(prompts, budgets)]
+    led = eng.telemetry.ledger
+    assert led.n_submitted == len(prompts) and led.n_admitted == 0
+
+    hand_admitted = hand_retired = 0
+    finished = {}
+    steps = 0
+    while eng.pending:
+        a, r = eng.step()
+        hand_admitted += a
+        hand_retired += r
+        # lockstep: the ledger's lifetime counters ARE the hand tally
+        assert led.n_admitted == hand_admitted
+        assert led.n_retired == hand_retired
+        for f in eng.poll():
+            finished[f.request_id] = f.tokens
+        steps += 1
+        assert steps < 200
+    assert hand_admitted == hand_retired == len(prompts)
+    assert led.n_open == 0
+    assert led.tokens_emitted == sum(budgets) == sum(
+        len(t) for t in finished.values())
+
+    tail = {rec.request_id: rec for rec in led.tail}
+    assert set(tail) == set(rids)
+    for rid in rids:
+        rec = tail[rid]
+        assert rec.n_tokens == len(finished[rid])
+        assert (rec.submit_t <= rec.admit_t <= rec.first_token_t
+                <= rec.retire_t), rec.to_dict()
+        assert rec.queue_wait_s >= 0 and rec.ttft_s > 0
+    # churn: 8 requests into 3 slots — the first three admit
+    # immediately, the rest are head-of-line blocked behind live
+    # decodes, so their queue wait must dominate the first cohort's
+    waits = sorted(tail[r].queue_wait_s for r in rids)
+    first_cohort, blocked = waits[:3], waits[3:]
+    assert min(blocked) > 0.0
+    assert float(np.median(blocked)) > float(np.median(first_cohort))
+    # the very first admission never waited on anything
+    assert min(first_cohort) < min(blocked)
+    # estimators saw exactly the retired requests' samples
+    assert led.ttft.n == led.queue_wait.n == len(prompts)
+    want_p99 = float(np.percentile(
+        [tail[r].queue_wait_s for r in rids], 99))
+    np.testing.assert_allclose(led.queue_wait.percentile(99.0),
+                               want_p99, rtol=1e-12)
+
+
+def test_telemetry_off_is_bitwise_and_free():
+    """telemetry=False: no ledger, identical tokens (the observatory
+    observes, it never steers), and serve_record() is empty."""
+    params = _params(seed=11)
+    prompts = [[1, 2], [3, 4, 5], [7], [9, 10]]
+    budgets = [4, 6, 3, 5]
+    eng_on = DecodeEngine(_CFG, params, _SC)
+    eng_off = DecodeEngine(_CFG, params, _SC, telemetry=False)
+    assert eng_off.telemetry is None and eng_off.serve_record() == {}
+    on = {}
+    for p, b in zip(prompts, budgets):
+        rid = eng_on.submit(p, b)
+        on[rid] = None
+    for f in eng_on.run():
+        on[f.request_id] = f.tokens
+    off = {}
+    for p, b in zip(prompts, budgets):
+        rid = eng_off.submit(p, b)
+        off[rid] = None
+    for f in eng_off.run():
+        off[f.request_id] = f.tokens
+    assert on == off
+
+
+def test_restored_requests_reconcile_without_poisoning_estimators():
+    """Preemption resume (ISSUE 9 x ISSUE 10): a snapshot restored
+    into a fresh engine re-registers queued + in-flight requests so
+    retire events still reconcile — but in-flight ones are marked
+    `restored` and never feed the latency estimators (their stamps
+    are resume-relative)."""
+    params = _params(seed=11)
+    eng = DecodeEngine(_CFG, params, _SC)
+    for i in range(5):                       # 3 live + 2 queued
+        eng.submit([i + 1, i + 2], 6)
+    eng.step()
+    eng.step()
+    snap = eng.state_dict()
+    n_live = len(snap["scheduler"]["live"])
+    n_queued = len(snap["scheduler"]["pending"])
+    assert n_live == 3 and n_queued == 2
+
+    eng2 = DecodeEngine(_CFG, params, _SC)
+    eng2.load_state_dict(snap)
+    led2 = eng2.telemetry.ledger
+    assert led2.n_submitted == n_live + n_queued
+    assert led2.n_admitted == n_live         # in-flight re-registered
+    fins = eng2.run()
+    assert led2.n_retired == n_live + n_queued
+    assert len(fins) == n_live + n_queued
+    restored = [r for r in led2.tail if r.restored]
+    assert len(restored) == n_live
+    # only the re-queued cohort (real queue waits from the restore
+    # point) feeds the estimators
+    assert led2.ttft.n == n_queued
+    assert led2.queue_wait.n == n_queued
+
+    # in-place ROLLBACK on a non-fresh engine: the ledger is rebuilt,
+    # not appended to — pre-rollback rids are not double-counted and
+    # no record is stranded open, so reconciliation still closes
+    eng2.submit([9, 9], 3)                   # post-restore traffic
+    eng2.run()
+    eng2.load_state_dict(snap)               # roll eng2 itself back
+    led3 = eng2.telemetry.ledger
+    assert led3.n_submitted == n_live + n_queued
+    assert led3.n_retired == 0
+    eng2.run()
+    assert led3.n_retired == n_live + n_queued
+    assert led3.n_open == 0
+
+
+# ------------------------------------------------------------------
+# SCHEMA v7: serve_* stamps + MetricsLogger(serve=engine)
+# ------------------------------------------------------------------
+
+
+def _base_record():
+    return {
+        "monitor_schema_version": monitor.SCHEMA_VERSION, "step": 1,
+        "loss": 1.0, "grad_norm": 1.0, "param_norm": 1.0,
+        "update_norm": 0.1, "loss_scale": 1.0, "overflow_count": 0,
+        "skipped_steps": 0, "tokens_seen": 10.0, "step_time_ms": 1.0,
+        "tokens_per_sec": 10.0, "mfu": 0.1,
+    }
+
+
+def test_engine_serve_record_validates_v7():
+    """A drained engine's serve_record() carries the full v7 plane and
+    validates; nulls and mistyped values under the reserved prefix are
+    rejected (never-null, the v4 rule)."""
+    params = _params(seed=11)
+    eng = DecodeEngine(_CFG, params, _SC,
+                       slo=ServeSLO(ttft_p99_ms=1e9))
+    for i in range(4):
+        eng.submit([i + 1, i + 2], 4)
+    eng.run()
+    rec = _base_record()
+    sr = eng.serve_record()
+    for k in ("serve_queue_depth", "serve_slots_live",
+              "serve_pool_util", "serve_ttft_p50_ms",
+              "serve_ttft_p99_ms", "serve_token_p50_ms",
+              "serve_token_p99_ms", "serve_queue_wait_p99_ms",
+              "serve_queue_wait_max_ms", "serve_requests_retired",
+              "serve_tokens_emitted", "serve_slo_ok"):
+        assert k in sr, k
+    assert sr["serve_slo_ok"] is True
+    assert sr["serve_requests_retired"] == 4
+    rec.update(sr)
+    monitor.validate_record(rec)
+
+    with pytest.raises(ValueError, match="serve_ttft_p99_ms"):
+        monitor.validate_record(dict(rec, serve_ttft_p99_ms=None))
+    with pytest.raises(ValueError, match="serve_slo_ok"):
+        monitor.validate_record(dict(rec, serve_slo_ok=1))
+    with pytest.raises(ValueError, match="serve_queue_depth"):
+        monitor.validate_record(dict(rec, serve_queue_depth=1.5))
+    with pytest.raises(ValueError, match="scalar"):
+        monitor.validate_record(dict(rec, serve_gauges={"a": 1}))
+
+
+def test_metrics_logger_stamps_live_serve_plane(tmp_path):
+    """MetricsLogger(serve=engine): every record gains the live
+    gauges; percentile fields appear once requests have retired — and
+    the whole JSONL stream round-trips through validate_records."""
+    params = _params(seed=11)
+    eng = DecodeEngine(_CFG, params, _SC)
+    path = tmp_path / "m.jsonl"
+    logger = monitor.MetricsLogger([monitor.JSONLSink(str(path))],
+                                   serve=eng, log_tuner=False)
+    metrics = monitor.init_metrics()
+
+    # before any serving: gauges stamp (zeros), percentiles absent
+    metrics = metrics._replace(step=metrics.step + 1)
+    r1 = logger.log_step(metrics)
+    assert r1["serve_queue_depth"] == 0 and r1["serve_slots_live"] == 0
+    assert "serve_ttft_p99_ms" not in r1
+
+    for i in range(5):
+        eng.submit([i + 1, i + 2], 4)
+    eng.run()
+    metrics = metrics._replace(step=metrics.step + 1)
+    r2 = logger.log_step(metrics)
+    assert r2["serve_requests_retired"] == 5
+    assert r2["serve_ttft_p99_ms"] > 0
+    assert r2["serve_queue_wait_p99_ms"] >= 0
+    logger.close()
+
+    records = [json.loads(line) for line in path.read_text().splitlines()]
+    monitor.validate_records(records)
+    assert records[1]["serve_tokens_emitted"] == 20
+
+
+# ------------------------------------------------------------------
+# crash-dump attachment
+# ------------------------------------------------------------------
+
+
+def test_crash_dump_carries_ledger_tail(tmp_path):
+    """FlightRecorder.attach_serve (auto-hooked by the engine's
+    recorder= arg): the dump is valid JSON whose `serve` key holds a
+    schema-valid telemetry report with the ledger tail AS OF the
+    crash, and validate_report still accepts the full artifact (the
+    additive no-schema-change contract)."""
+    from apex_tpu.monitor.trace.report import validate_report
+
+    params = _params(seed=11)
+    rec = monitor.FlightRecorder(str(tmp_path / "flight.json"),
+                                 capacity=8)
+    eng = DecodeEngine(_CFG, params, _SC, recorder=rec)
+    for i in range(4):
+        eng.submit([i + 1, i + 2], 3)
+    with pytest.raises(RuntimeError, match="boom"):
+        with rec.guard():
+            while eng.pending:
+                eng.step()
+                if eng.telemetry.ledger.n_retired >= 2:
+                    raise RuntimeError("boom")
+
+    with open(tmp_path / "flight.json") as f:
+        dump = json.load(f)                      # valid JSON, period
+    validate_report(dump)
+    serve = dump["serve"]
+    validate_serve_report(serve)
+    assert serve["ledger"]["n_retired"] >= 2
+    assert len(serve["ledger_tail"]) == serve["ledger"]["n_retired"]
+    for entry in serve["ledger_tail"]:
+        assert entry["retire_t"] >= entry["submit_t"]
+    assert serve["stats"]["n_slots"] == _SC.n_slots
+
+    # a dict attachment (post-mortem path) works the same way
+    rec2 = monitor.FlightRecorder(str(tmp_path / "f2.json"))
+    rec2.attach_serve(eng.telemetry_report())
+    dump2 = rec2.dump()
+    validate_serve_report(dump2["serve"])
+
+
+# ------------------------------------------------------------------
+# SLO verdicts
+# ------------------------------------------------------------------
+
+
+def test_slo_verdict_names_axis_and_percentile():
+    slo = ServeSLO(ttft_p99_ms=10.0, per_token_p99_ms=5.0,
+                   max_queue_wait_ms=100.0)
+    ok = slo.evaluate_summary({"ttft_p99_ms": 9.0,
+                               "per_token_p99_ms": 4.0,
+                               "queue_wait_max_ms": 99.0})
+    assert ok.ok and not ok.breaches and not ok.skipped
+    assert "OK" in ok.describe()
+
+    bad = slo.evaluate_summary({"ttft_p99_ms": 25.0,
+                                "per_token_p99_ms": 4.0,
+                                "queue_wait_max_ms": 250.0})
+    assert not bad.ok
+    axes = {(b.axis, b.percentile) for b in bad.breaches}
+    assert axes == {("ttft", "p99"), ("queue_wait", "max")}
+    assert "ttft" in bad.describe() and "queue_wait" in bad.describe()
+    d = bad.to_dict()
+    assert d["ok"] is False and len(d["breaches"]) == 2
+
+    # a configured axis with NO samples is skipped, never green —
+    # and a partially-skipped green is NOT grounded (must not stamp)
+    sk = slo.evaluate_summary({"ttft_p99_ms": 9.0,
+                               "per_token_p99_ms": None,
+                               "queue_wait_max_ms": None})
+    assert sk.ok and set(sk.skipped) == {"per_token", "queue_wait"}
+    assert sk.n_judged == 1 and not sk.grounded
+    # a breach is always grounded, even with other axes skipped
+    skbad = slo.evaluate_summary({"ttft_p99_ms": 99.0,
+                                  "per_token_p99_ms": None,
+                                  "queue_wait_max_ms": None})
+    assert not skbad.ok and skbad.grounded
+    # fully measured green IS grounded
+    assert ok.n_judged == 3 and ok.grounded
+    assert ok.to_dict()["grounded"] is True
+    # disabled axes are neither judged nor skipped; an all-disabled
+    # SLO judges nothing and grounds nothing
+    none = ServeSLO().evaluate_summary({"ttft_p99_ms": 1e9})
+    assert none.ok and not none.skipped
+    assert none.n_judged == 0 and not none.grounded
+
+
+def test_zero_span_requests_carry_no_per_token_signal():
+    """A request that finishes within its admitting step has its
+    first-token and retire stamps ride the SAME poll: per_token_s is
+    None (not 0.0 — a zero sample would deflate the estimator, and a
+    per_token SLO would pass vacuously on short-request workloads)."""
+    params = _params(seed=11)
+    eng = DecodeEngine(_CFG, params, _SC)
+    for i in range(3):
+        eng.submit([i + 1, i + 2], 2)     # prefill token + 1 decode:
+    eng.run()                             # done within admitting step
+    led = eng.telemetry.ledger
+    assert led.n_retired == 3
+    for rec in led.tail:
+        assert rec.n_tokens == 2
+        assert rec.decode_s == 0.0        # same-poll stamps...
+        assert rec.per_token_s is None    # ...are not a latency sample
+    assert led.token_lat.n == 0
+    # and the SLO correctly reports the axis as unmeasured
+    v = eng.slo_verdict(ServeSLO(per_token_p99_ms=1.0))
+    assert v.ok and v.skipped == ["per_token"] and not v.grounded
+
+
+def test_slo_ok_stamp_requires_grounded_verdict():
+    """serve_record must NOT stamp serve_slo_ok while every configured
+    axis is unmeasured (idle engine) — a vacuous green in the JSONL
+    stream would be indistinguishable from a measured pass."""
+    params = _params(seed=11)
+    eng = DecodeEngine(_CFG, params, _SC,
+                       slo=ServeSLO(ttft_p99_ms=1e9))
+    assert "serve_slo_ok" not in eng.serve_record()   # nothing served
+    eng.submit([1, 2], 3)
+    eng.run()
+    assert eng.serve_record()["serve_slo_ok"] is True  # now grounded
+
+
+def test_measure_decode_warm_param_reaches_live_estimator():
+    """measure_decode(warm=N) must apply the SAME warmup exclusion to
+    the live step-time estimator it feeds — the two views of the one
+    convention cannot disagree."""
+    params = _params(seed=11)
+    eng5 = DecodeEngine(_CFG, params, _SC)
+    eng5.submit([1, 2], 8)
+    m5 = measure_decode(eng5, warm=5)
+    pct5 = step_latency_percentiles(m5["per_step_s"], m5["churn"],
+                                    warm=5)
+    assert eng5.telemetry.step_lat.n == pct5["pure_decode_steps"]
+
+
+# ------------------------------------------------------------------
+# the standing CI gates (scripts/slo_probe.py)
+# ------------------------------------------------------------------
+
+
+def test_slo_probe_selftest():
+    """Tier-1 fixture-drift gate (mirrors resume_probe --selftest):
+    the committed telemetry report still validates, the estimator
+    reproduces the oracle, and the SEEDED BREACH negative control is
+    flagged with the `ttft` axis named."""
+    r = _run_script(ROOT / "scripts" / "slo_probe.py", "--selftest")
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "slo_probe --selftest: OK" in r.stdout
+    # the negative control is asserted BY NAME: the fixture seeds a
+    # ttft-p99 breach and the verdict must name that axis
+    with open(ROOT / "scripts" / "slo_fixture.json") as f:
+        fixture = json.load(f)
+    br = fixture["seeded_breach"]
+    assert br["expect_axis"] == "ttft"
+    verdict = ServeSLO(**br["slo"]).evaluate_summary(br["summary"])
+    assert not verdict.ok
+    assert "ttft" in [b.axis for b in verdict.breaches]
+
+
+def test_slo_probe_full_gate():
+    """The standing serving-observatory gate (ISSUE 10 acceptance):
+    churn workload on the flagship build path — ledger reconciles
+    exactly with step() accounting, estimators match the oracle, SLO
+    green, zero steady-state recompiles, decode bitwise with
+    telemetry off."""
+    r = _run_script(ROOT / "scripts" / "slo_probe.py",
+                    "--requests", "12", "--max-new", "4", "--json")
+    assert r.returncode == 0, r.stdout + r.stderr
+    payload = None
+    for line in reversed(r.stdout.strip().splitlines()):
+        try:
+            payload = json.loads(line)
+            break
+        except json.JSONDecodeError:
+            continue
+    assert payload is not None, r.stdout
+    assert payload["ok"] is True
+    assert payload["ledger_reconciles"] is True
+    assert payload["bitwise_telemetry_off"] is True
+    assert payload["recompile_ok"] is True
+    assert payload["slo_ok"] is True
