@@ -21,21 +21,34 @@ stack: save → kill → restore → trajectory-match.
 """
 
 from apex_tpu.checkpoint import chaos  # noqa: F401
+from apex_tpu.checkpoint import multihost  # noqa: F401
 from apex_tpu.checkpoint.legacy import (  # noqa: F401
     latest_step,
     load_checkpoint,
     save_checkpoint,
 )
 from apex_tpu.checkpoint.manager import CheckpointManager  # noqa: F401
+from apex_tpu.checkpoint.multihost import (  # noqa: F401
+    MultihostCommitError,
+    save_sharded_multihost,
+)
+from apex_tpu.checkpoint.orchestrator import (  # noqa: F401
+    ElasticOrchestrator,
+    EscalationError,
+    RetryPolicy,
+)
 from apex_tpu.checkpoint.sharded import (  # noqa: F401
     CKPT_SCHEMA_VERSION,
     CheckpointError,
     IncompleteCheckpointError,
     LayoutMismatchError,
     latest_committed_step,
+    load_model_state,
+    pack_model_state,
     read_manifest,
     restore_sharded,
     save_sharded,
+    unpack_model_state,
     validate_manifest,
     verify_shards,
 )
@@ -44,16 +57,25 @@ __all__ = [
     "CKPT_SCHEMA_VERSION",
     "CheckpointError",
     "CheckpointManager",
+    "ElasticOrchestrator",
+    "EscalationError",
     "IncompleteCheckpointError",
     "LayoutMismatchError",
+    "MultihostCommitError",
+    "RetryPolicy",
     "chaos",
     "latest_committed_step",
     "latest_step",
     "load_checkpoint",
+    "load_model_state",
+    "multihost",
+    "pack_model_state",
     "read_manifest",
     "restore_sharded",
     "save_checkpoint",
     "save_sharded",
+    "save_sharded_multihost",
+    "unpack_model_state",
     "validate_manifest",
     "verify_shards",
 ]

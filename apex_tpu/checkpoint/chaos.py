@@ -43,12 +43,68 @@ class SimulatedPreemption(RuntimeError):
 
 
 class RankLostError(RuntimeError):
-    """A dp rank is declared lost/stalled by the watchdog."""
+    """A dp rank is declared lost/stalled by the watchdog.  Carries the
+    structured fields the elastic orchestrator needs to pick the
+    surviving topology: `rank` (which one died) and `last_committed`
+    (the resume point, None when nothing ever committed)."""
+
+    def __init__(self, msg: str, rank: Optional[int] = None,
+                 last_committed: Optional[int] = None):
+        super().__init__(msg)
+        self.rank = rank
+        self.last_committed = last_committed
 
 
 _ARMED: Dict[str, int] = {}
 
-POINTS = ("ckpt.before_shards", "ckpt.mid_shards", "ckpt.before_manifest")
+# the single-host writer's checked points — every one of these fires
+# inside `sharded.save_sharded` (the kill-matrix loops iterate them)
+CKPT_POINTS = ("ckpt.before_shards", "ckpt.mid_shards",
+               "ckpt.before_manifest")
+# multi-host fail points (ISSUE 11): a host dying before it publishes
+# its per-host sub-manifest, process 0 dying before the global
+# manifest barrier, and a rank dying mid-training-step.  Checked by
+# `multihost.py`'s writer and the fleet workers' step loops (the
+# ckpt.* shard-write points fire inside the multi-host writer too).
+HOST_POINTS = ("host.before_submanifest", "host.before_barrier",
+               "rank.lost_at_step")
+POINTS = CKPT_POINTS + HOST_POINTS  # everything arm() accepts
+
+# Cross-process arming (the fleet probe's kill switch): the LAUNCHER
+# can't call arm() inside a child, so children read these env vars.
+# APEX_TPU_CHAOS        "point:count[,point:count...]"
+# APEX_TPU_CHAOS_PROC   arm only in the child whose
+#                       APEX_TPU_PROCESS_ID matches (absent = all)
+ENV_VAR = "APEX_TPU_CHAOS"
+ENV_PROC_VAR = "APEX_TPU_CHAOS_PROC"
+
+
+def arm_from_env(environ=None, var: str = ENV_VAR) -> list:
+    """Arm fail points named by ``APEX_TPU_CHAOS`` (workers call this
+    once at startup).  Honors ``APEX_TPU_CHAOS_PROC``: when set, only
+    the child whose ``APEX_TPU_PROCESS_ID`` matches arms anything — the
+    fleet probe's way of killing ONE specific host.  `var` reads the
+    spec from a different variable (the probe stages save-time kills
+    under ``APEX_TPU_CHAOS_SAVE`` so the commit of an EARLIER step
+    isn't the one that fires).  Returns the (point, count) list
+    actually armed."""
+    env = os.environ if environ is None else environ
+    spec = env.get(var, "").strip()
+    if not spec:
+        return []
+    target = env.get(ENV_PROC_VAR, "").strip()
+    if target and env.get("APEX_TPU_PROCESS_ID", "").strip() != target:
+        return []
+    armed = []
+    for item in spec.split(","):
+        item = item.strip()
+        if not item:
+            continue
+        point, sep, count = item.partition(":")
+        n = int(count) if sep else 1
+        arm(point, n)
+        armed.append((point, n))
+    return armed
 
 
 def arm(point: str, count: int = 1) -> None:
@@ -181,7 +237,18 @@ class LostRankWatchdog:
     `RankLostError` naming the rank, its skew, and — when a manager is
     attached — the last committed checkpoint step.  Under
     `resume_guard` that exception becomes a crash dump whose reason IS
-    the resume runbook."""
+    the resume runbook.
+
+    Flap recovery: a rank that RECOVERS (its skew drops back below the
+    detector's threshold for a step) resets to zero consecutive flags —
+    it is never left one slow step away from a spurious
+    `RankLostError`.  `check()` only judges each detector summary ONCE
+    (keyed on its step index): re-checking between updates — a loop
+    that polls the watchdog more often than it folds timings — can
+    neither re-raise on stale data nor double-count.  `reset()` clears
+    the detector for an elastic topology change (the orchestrator calls
+    it on rebuild: rank counts legitimately change at dp=N→M and the
+    detector otherwise refuses a mid-run rank-count change)."""
 
     def __init__(self, straggler, manager=None, deadline: int = 10):
         if deadline < 1:
@@ -189,6 +256,13 @@ class LostRankWatchdog:
         self.straggler = straggler
         self.manager = manager
         self.deadline = deadline
+        self._judged_step: Optional[int] = None
+
+    def reset(self) -> None:
+        """Forget all flap history — the elastic-resume rebuild hook."""
+        self._judged_step = None
+        if hasattr(self.straggler, "reset"):
+            self.straggler.reset()
 
     def check(self, timings=None) -> Optional[dict]:
         """Fold `timings` (when given) and raise if any rank crossed the
@@ -198,6 +272,9 @@ class LostRankWatchdog:
         last = self.straggler.last
         if not last:
             return None
+        if last.get("step_index") == self._judged_step:
+            return last  # already judged this summary — stale re-check
+        self._judged_step = last.get("step_index")
         for f in last["flagged"]:
             if f["consecutive"] >= self.deadline:
                 lc = (self.manager.last_committed_step
@@ -209,5 +286,6 @@ class LostRankWatchdog:
                     f"consecutive steps beyond "
                     f"{self.straggler.threshold}x the median (skew "
                     f"{f['skew']:.2f}); resume from last committed "
-                    f"checkpoint: {where}")
+                    f"checkpoint: {where}",
+                    rank=int(f["rank"]), last_committed=lc)
         return last

@@ -21,11 +21,20 @@ writer thread paid) land in `stats()`, which
 ``MetricsLogger(ckpt=manager)`` stamps into every telemetry record —
 the bench JSON prices the cadence with the same two numbers.
 
-Single-controller: the manager assumes every shard is addressable from
-this process (the repo's virtual CPU mesh and the single-controller TPU
-runtime both are).  A multi-host deployment writes per-host shard
-subsets with rank-0 committing the manifest — the named extension in
-docs/checkpointing.md.
+Multi-host (ISSUE 11): pass ``process_id``/``num_processes`` and every
+controller process writes only its LOCAL ranks' shard files plus a
+per-host sub-manifest; process 0 commits the global manifest only
+after every host's sub-manifest is present and crc-verified
+(`checkpoint.multihost` owns the barrier protocol).  A kill of any
+host at any point never yields a loadable partial.  Process 0
+additionally stamps `ckpt_commit_barrier_s` — how long the commit
+barrier waited on the slowest host — into `stats()`.
+
+Model state outside the optimizer (RNG key, BN running stats) rides
+the same commit: pass ``model_state={"rng_key": key, ...}`` to
+`save`/`maybe_save` and read it back with `restore_model_state()` —
+one manifest covers the whole run (rank-0 replicated fields, never fed
+to the optimizer state).
 """
 
 from __future__ import annotations
@@ -51,7 +60,11 @@ class CheckpointManager:
     def __init__(self, directory: str, optimizer=None, *,
                  every_n_steps: int = 100, keep: int = 2,
                  axis_name: Optional[str] = None,
-                 async_write: bool = True):
+                 async_write: bool = True,
+                 process_id: Optional[int] = None,
+                 num_processes: Optional[int] = None,
+                 local_ranks=None, attempt: Optional[int] = None,
+                 barrier_timeout_s: float = 120.0):
         if every_n_steps < 1:
             raise ValueError(
                 f"every_n_steps must be >= 1, got {every_n_steps}")
@@ -64,34 +77,84 @@ class CheckpointManager:
         self.axis_name = axis_name or getattr(optimizer, "axis_name",
                                               None) or "dp"
         self.async_write = async_write
+        # multi-host commit (checkpoint.multihost): each id falls back
+        # to the launcher's env INDEPENDENTLY — a caller passing only
+        # num_processes=N must still pick up its per-process id, or
+        # every host would believe it is process 0
+        if num_processes is None:
+            num_processes = int(os.environ.get(
+                "APEX_TPU_NUM_PROCESSES", "1") or 1)
+        if process_id is None:
+            process_id = int(os.environ.get(
+                "APEX_TPU_PROCESS_ID", "0") or 0)
+        self.num_processes = int(num_processes)
+        self.process_id = int(process_id)
+        if not 0 <= self.process_id < self.num_processes:
+            raise ValueError(
+                f"process_id {self.process_id} outside "
+                f"[0, {self.num_processes})")
+        self.local_ranks = (None if local_ranks is None
+                            else sorted(int(r) for r in local_ranks))
+        self.attempt = attempt  # None: resolved from APEX_TPU_ATTEMPT
+        self.barrier_timeout_s = barrier_timeout_s
         self._thread: Optional[threading.Thread] = None
         self._error: Optional[BaseException] = None
         self._last_requested: Optional[int] = None
         self._stats: Dict[str, Any] = {}
+
+    @property
+    def multihost(self) -> bool:
+        return self.num_processes > 1
+
+    def _resolve_attempt(self) -> int:
+        if self.attempt is not None:
+            return int(self.attempt)
+        return int(os.environ.get("APEX_TPU_ATTEMPT", "0") or 0)
+
+    def _resolve_local_ranks(self, num_shards: int):
+        from apex_tpu.checkpoint import multihost as MH
+        if self.local_ranks is not None:
+            return self.local_ranks
+        return MH.local_ranks(self.process_id, self.num_processes,
+                              num_shards)
 
     # ------------------------------------------------------------------
     # save path
     # ------------------------------------------------------------------
 
     def maybe_save(self, step: int, opt_state, scaler_state=None,
-                   extra: Optional[dict] = None) -> bool:
+                   extra: Optional[dict] = None,
+                   model_state: Optional[dict] = None) -> bool:
         """Save iff `step` is on the cadence (and not already saved).
         Returns whether a save was started — commit is asynchronous;
         `wait()` blocks until it lands."""
         step = int(step)
         if step == self._last_requested or step % self.every_n_steps:
             return False
-        self.save(step, opt_state, scaler_state, extra=extra)
+        self.save(step, opt_state, scaler_state, extra=extra,
+                  model_state=model_state)
         return True
 
     def save(self, step: int, opt_state, scaler_state=None, *,
-             extra: Optional[dict] = None) -> None:
+             extra: Optional[dict] = None,
+             model_state: Optional[dict] = None) -> None:
         """Unconditional save of `step`.  Blocking cost = wait for the
         previous in-flight write + the device→host snapshot; the file
-        I/O runs on the writer thread."""
+        I/O runs on the writer thread.  `model_state`: a (nested) dict
+        of replicated rank-0 arrays (RNG key, BN stats) committed in
+        the SAME manifest (multi-host: only process 0 writes them)."""
         t0 = time.perf_counter()
         self.wait()  # double buffer: at most one write in flight
         fields = self._snapshot(opt_state)
+        if model_state:
+            if not self.multihost or self.process_id == 0:
+                packed = S.pack_model_state(model_state)
+                clash = set(packed) & set(fields)
+                if clash:
+                    raise S.CheckpointError(
+                        f"model state collides with optimizer fields: "
+                        f"{sorted(clash)}")
+                fields.update(packed)
         scaler = None
         if scaler_state is not None:
             from apex_tpu.amp import scaler as scaler_lib
@@ -108,27 +171,59 @@ class CheckpointManager:
         blocking = time.perf_counter() - t0
         self._last_requested = int(step)
         total = sum(
-            sum(int(np.asarray(a).nbytes) for a in v)
+            sum(int(np.asarray(a).nbytes) for a in
+                (v.values() if isinstance(v, dict) else v))
             if kind == "sharded" else int(np.asarray(v).nbytes)
             for kind, v in fields.values())
 
         def _write():
             t1 = time.perf_counter()
             try:
-                S.save_sharded(
-                    self.directory, step, fields, flat_layout=layout,
-                    scaler=scaler, tuner_fingerprint=fingerprint,
-                    extra=extra, overwrite=True)
+                if self.multihost:
+                    from apex_tpu.checkpoint import multihost as MH
+                    _, barrier_s = MH.save_sharded_multihost(
+                        self.directory, step, fields,
+                        process_id=self.process_id,
+                        num_processes=self.num_processes,
+                        attempt=self._resolve_attempt(),
+                        flat_layout=layout, scaler=scaler,
+                        tuner_fingerprint=fingerprint, extra=extra,
+                        timeout_s=self.barrier_timeout_s)
+                else:
+                    S.save_sharded(
+                        self.directory, step, fields, flat_layout=layout,
+                        scaler=scaler, tuner_fingerprint=fingerprint,
+                        extra=extra, overwrite=True)
                 # ONE atomic update at commit time: every ckpt_* stat
                 # describes the SAME save (a logger reading between a
                 # save() call and its commit must never see this
                 # save's blocking next to the previous save's clock)
-                self._stats.update(
+                stats = dict(
                     ckpt_blocking_s=round(blocking, 6),
                     ckpt_save_s=round(time.perf_counter() - t1, 6),
                     ckpt_last_step=int(step),
                     ckpt_bytes=int(total))
-                S.prune(self.directory, self.keep)
+                if self.multihost:
+                    if self.process_id == 0:
+                        # how long the commit barrier waited on the
+                        # slowest host's sub-manifest (schema v8)
+                        stats["ckpt_commit_barrier_s"] = round(
+                            barrier_s, 6)
+                    else:
+                        # a non-zero host never observes the commit —
+                        # its resume point is whatever disk says
+                        lc = S.latest_committed_step(self.directory)
+                        if lc is None:
+                            stats.pop("ckpt_last_step")
+                        else:
+                            stats["ckpt_last_step"] = int(lc)
+                self._stats.update(stats)
+                # prune on process 0 only: N hosts racing rmtree over a
+                # shared store would tear each other's sweeps apart
+                # (and partials NEWER than the newest commit — another
+                # host's in-flight staging — are never pruned anyway)
+                if not self.multihost or self.process_id == 0:
+                    S.prune(self.directory, self.keep)
             except BaseException as e:
                 self._error = e
                 raise
@@ -147,7 +242,16 @@ class CheckpointManager:
                 target=_quiet, name=f"ckpt-write-step{step}", daemon=True)
             self._thread.start()
         else:
-            _write()
+            try:
+                _write()
+            except BaseException:
+                # surfaced HERE, synchronously — clearing the deferred
+                # copy keeps the next save()'s wait() from re-raising a
+                # stale error and silently skipping ITS write (a fleet
+                # that recovers after one refused commit must not lose
+                # its next resume point)
+                self._error = None
+                raise
 
     def wait(self) -> None:
         """Block until the in-flight write (if any) committed; re-raise
@@ -161,8 +265,44 @@ class CheckpointManager:
             err, self._error = self._error, None
             raise err
 
+    def _host_shards(self, name: str, v, num: int) -> Dict[int, Any]:
+        """{global_rank: host array} for a ``P(dp)``-sharded 1-D leaf.
+        Multi-controller arrays (not fully addressable) are assembled
+        from `addressable_shards` — the only shards this process CAN
+        fetch; single-controller arrays split the full host copy."""
+        shards = getattr(v, "addressable_shards", None)
+        if (shards and not getattr(v, "is_fully_addressable", True)):
+            glen = int(v.shape[0])
+            if glen % num:
+                raise S.CheckpointError(
+                    f"field {name!r}: global length {glen} not "
+                    f"divisible by num_shards {num}")
+            per = glen // num
+            out: Dict[int, Any] = {}
+            for sh in shards:
+                idx = sh.index[0] if sh.index else slice(0, glen)
+                start = int(idx.start or 0)
+                if start % per:
+                    raise S.CheckpointError(
+                        f"field {name!r}: device shard at offset "
+                        f"{start} does not align with the {num}-way "
+                        "rank split — is the leaf sharded over a "
+                        "different axis?")
+                out.setdefault(start // per, np.asarray(sh.data))
+            return out
+        host = np.asarray(v)
+        if host.shape[0] % num:
+            raise S.CheckpointError(
+                f"field {name!r}: global length {host.shape[0]} "
+                f"not divisible by num_shards {num}")
+        return dict(enumerate(np.split(host, num)))
+
     def _snapshot(self, opt_state) -> Dict[str, tuple]:
-        """Device→host copy, split per `state_partition_specs()`."""
+        """Device→host copy, split per `state_partition_specs()`.
+        Multi-host mode keeps only this process's `local_ranks` for
+        sharded fields and drops replicated fields on non-zero hosts
+        (they are rank-0 state — `multihost.write_host_shards`
+        enforces it)."""
         d = (opt_state._asdict() if hasattr(opt_state, "_asdict")
              else dict(opt_state))
         specs = None
@@ -179,21 +319,32 @@ class CheckpointManager:
                     v.copy_to_host_async()
                 except Exception:  # pragma: no cover — fetch still works
                     pass
+        local = (set(self._resolve_local_ranks(num)) if self.multihost
+                 else None)
         fields: Dict[str, tuple] = {}
         for name, v in d.items():
             spec = specs.get(name) if specs else None
             is_sharded = bool(spec) and self.axis_name in tuple(spec)
-            host = np.asarray(v)
-            if is_sharded and num > 1:
-                if host.shape[0] % num:
-                    raise S.CheckpointError(
-                        f"field {name!r}: global length {host.shape[0]} "
-                        f"not divisible by num_shards {num}")
-                fields[name] = ("sharded", list(np.split(host, num)))
-            elif is_sharded:
-                fields[name] = ("sharded", [host])
-            else:
-                fields[name] = ("replicated", host)
+            if is_sharded:
+                by_rank = self._host_shards(name, v, num) if num > 1 \
+                    else {0: np.asarray(v)}
+                if local is None:
+                    fields[name] = ("sharded",
+                                    [by_rank[r] for r in sorted(by_rank)])
+                else:
+                    mine = {r: a for r, a in by_rank.items()
+                            if r in local}
+                    missing = local - set(mine)
+                    if missing:
+                        raise S.CheckpointError(
+                            f"field {name!r}: local ranks "
+                            f"{sorted(missing)} are not addressable "
+                            "from this process — local_ranks does not "
+                            "match the device placement")
+                    if mine:  # a host with zero ranks skips the field
+                        fields[name] = ("sharded", mine)
+            elif not self.multihost or self.process_id == 0:
+                fields[name] = ("replicated", np.asarray(v))
         return fields
 
     # ------------------------------------------------------------------
@@ -218,6 +369,14 @@ class CheckpointManager:
         return S.restore_sharded(
             self.directory, self.optimizer, mesh=mesh, step=step,
             axis_name=self.axis_name, verify_crc=verify_crc)
+
+    def restore_model_state(self, step: Optional[int] = None, *,
+                            verify_crc: bool = True) -> dict:
+        """The ``model.*`` fields (RNG key, BN stats, …) of one
+        committed step as a nested host-array dict — {} when that step
+        carries none.  Pair with `restore()` at the SAME step."""
+        return S.load_model_state(self.directory, step,
+                                  verify_crc=verify_crc)
 
     def stats(self) -> Dict[str, Any]:
         """The `ckpt_*` telemetry scalars of the newest save (empty

@@ -45,6 +45,11 @@ import numpy as np
 
 CKPT_SCHEMA_VERSION = 1
 MANIFEST = "manifest.json"
+# model state outside the optimizer (RNG key, BN running stats) rides
+# the SAME manifest as replicated rank-0 fields under this name prefix
+# — one commit covers the whole run.  `restore_sharded` never feeds
+# them to the optimizer state; `load_model_state` returns them.
+MODEL_PREFIX = "model."
 
 
 class CheckpointError(RuntimeError):
@@ -86,6 +91,29 @@ def _crc(raw: bytes) -> int:
 
 def step_dir(directory: str, step: int) -> str:
     return os.path.join(os.path.abspath(directory), f"step_{int(step)}")
+
+
+def write_rank_file(d: str, name: str, kind: str, rank: int, value, *,
+                    expect_dtype: Optional[str] = None) -> Tuple[dict, list]:
+    """Write ONE (field, rank) shard file and return its manifest file
+    entry + shape.  The single definition of the on-disk format — file
+    naming, contiguity, byte count, crc32 — shared by the single-host
+    writer and the multi-host per-host writer so the two can never
+    silently diverge."""
+    a = np.asarray(value)
+    shape = a.shape  # before ascontiguousarray: it promotes 0-d
+    a = np.ascontiguousarray(a)
+    if expect_dtype is not None and str(a.dtype) != expect_dtype:
+        raise ValueError(
+            f"field {name!r}: rank {rank} dtype {a.dtype} != "
+            f"{expect_dtype}")
+    fn = (f"{name}.rank{rank:03d}.bin" if kind == "sharded"
+          else f"{name}.bin")
+    raw = a.tobytes()
+    with open(os.path.join(d, fn), "wb") as f:
+        f.write(raw)
+    return ({"rank": rank, "file": fn, "bytes": len(raw),
+             "crc32": _crc(raw)}, list(shape))
 
 
 # ---------------------------------------------------------------------------
@@ -136,7 +164,9 @@ def save_sharded(directory: str, step: int, fields: Dict[str, tuple], *,
         for f in os.listdir(d):
             p = os.path.join(d, f)
             if os.path.isdir(p) or not (
-                    f == MANIFEST or f.endswith((".bin", ".tmp"))):
+                    f == MANIFEST or f.endswith((".bin", ".tmp"))
+                    or (f.startswith("manifest.host")
+                        and f.endswith(".json"))):
                 raise CheckpointError(
                     f"{d} holds {f!r}, which is not a sharded-"
                     "checkpoint artifact — refusing to clear a "
@@ -166,22 +196,11 @@ def save_sharded(directory: str, step: int, fields: Dict[str, tuple], *,
                  "num_shards": len(arrs) if kind == "sharded" else 1,
                  "shapes": [], "files": []}
         for r, a in enumerate(arrs):
-            a = np.asarray(a)
-            shape = a.shape  # before ascontiguousarray: it promotes 0-d
-            a = np.ascontiguousarray(a)
-            if str(a.dtype) != entry["dtype"]:
-                raise ValueError(
-                    f"field {name!r}: rank {r} dtype {a.dtype} != rank 0 "
-                    f"dtype {entry['dtype']}")
-            fn = (f"{name}.rank{r:03d}.bin" if kind == "sharded"
-                  else f"{name}.bin")
-            raw = a.tobytes()
-            with open(os.path.join(d, fn), "wb") as f:
-                f.write(raw)
-            entry["shapes"].append(list(shape))
-            entry["files"].append({"rank": r, "file": fn,
-                                   "bytes": len(raw), "crc32": _crc(raw)})
-            total += len(raw)
+            fe, shape = write_rank_file(d, name, kind, r, a,
+                                        expect_dtype=entry["dtype"])
+            entry["shapes"].append(shape)
+            entry["files"].append(fe)
+            total += fe["bytes"]
             chaos.check("ckpt.mid_shards")
         manifest["fields"][name] = entry
     manifest["total_bytes"] = total
@@ -426,6 +445,67 @@ def load_field_host(path: str, manifest: dict, name: str, *,
     return out if e["kind"] == "sharded" else out[0]
 
 
+def pack_model_state(tree: dict) -> Dict[str, tuple]:
+    """Flatten a (possibly nested) dict of model-state arrays — RNG
+    keys, BN running stats, anything outside the optimizer — into
+    replicated manifest fields named ``model.<dotted.path>``.  Keys are
+    joined with ``"."`` so they must not themselves contain ``"."``
+    (or ``"/"``, which cannot appear in a shard file name)."""
+    out: Dict[str, tuple] = {}
+
+    def _walk(prefix, node):
+        if isinstance(node, dict):
+            if not node:
+                raise ValueError(
+                    f"model state {prefix or '<root>'!r} is an empty dict")
+            for k, v in node.items():
+                k = str(k)
+                if "." in k or "/" in k:
+                    raise ValueError(
+                        f"model state key {k!r} contains '.'/'/' — the "
+                        "manifest joins nested keys with '.'")
+                _walk(f"{prefix}.{k}" if prefix else k, v)
+        else:
+            out[MODEL_PREFIX + prefix] = ("replicated", np.asarray(node))
+
+    _walk("", dict(tree))
+    return out
+
+
+def unpack_model_state(fields: Dict[str, np.ndarray]) -> dict:
+    """Inverse of `pack_model_state`: ``model.a.b`` names back into a
+    nested dict (prefix-less input keys are accepted too)."""
+    root: dict = {}
+    for name, value in fields.items():
+        path = name[len(MODEL_PREFIX):] if name.startswith(MODEL_PREFIX) \
+            else name
+        parts = path.split(".")
+        node = root
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = value
+    return root
+
+
+def load_model_state(directory: str, step: Optional[int] = None, *,
+                     verify_crc: bool = True) -> dict:
+    """The ``model.*`` replicated fields of one committed step as a
+    nested host-array dict ({} when the checkpoint carries none).
+    step=None reads the latest committed step."""
+    directory = os.path.abspath(directory)
+    if step is None:
+        step = latest_committed_step(directory)
+        if step is None:
+            raise CheckpointError(
+                f"no committed checkpoint under {directory}")
+    p = step_dir(directory, step)
+    m = read_manifest(p)
+    names = [n for n in m["fields"] if n.startswith(MODEL_PREFIX)]
+    return unpack_model_state(
+        {n: load_field_host(p, m, n, check_crc=verify_crc)
+         for n in names})
+
+
 def _check_layouts(src: dict, dst: dict) -> None:
     for key in ("align", "total", "n_tensors", "master_dtype"):
         if src.get(key) != dst.get(key):
@@ -550,8 +630,12 @@ def restore_sharded(directory: str, optimizer, *, mesh=None,
         p = step_dir(directory, s)
         m = read_manifest(p)
         verify_shards(p, m, crc=False)
+        # model.* fields never reach the optimizer state — reading
+        # them here would double the restore I/O the moment
+        # restore_model_state reads them for real
         return m, {n: load_field_host(p, m, n, check_crc=verify_crc)
-                   for n in m["fields"]}
+                   for n in m["fields"]
+                   if not n.startswith(MODEL_PREFIX)}
 
     try:
         manifest, host_values = _load_step(step)
@@ -577,7 +661,8 @@ def restore_sharded(directory: str, optimizer, *, mesh=None,
         step, manifest, host_values = fallback
 
     sharded_fields = [n for n, e in manifest["fields"].items()
-                     if e["kind"] == "sharded"]
+                     if e["kind"] == "sharded"
+                     and not n.startswith(MODEL_PREFIX)]
     dst_layout = None
     if sharded_fields:
         if not hasattr(optimizer, "shard_layout"):
@@ -603,6 +688,9 @@ def restore_sharded(directory: str, optimizer, *, mesh=None,
 
     values = {}
     for name, e in manifest["fields"].items():
+        if name.startswith(MODEL_PREFIX):
+            continue  # model state: fetched via load_model_state, never
+            # mistaken for a missing optimizer-state field
         host = host_values[name]
         if e["kind"] == "sharded":
             global_host = reshard(host, src_layout, dst_layout)

@@ -77,7 +77,17 @@ from apex_tpu.monitor.sinks import MetricSink, ScalarWriter
 # is NOT stamped: a vacuous green would paint an outage window).  All
 # OPTIONAL, never-null when present (the v4 rule: no samples → no
 # field, never a null), same reserved `serve_` scalar prefix as v5.
-SCHEMA_VERSION = 7
+# v8 (ISSUE 11): the fleet fault-tolerance fields —
+# `ckpt_commit_barrier_s` (how long process 0's multi-host commit
+# barrier waited on the slowest host's sub-manifest; stamped only by a
+# multi-host CheckpointManager on process 0), `fleet_resumes`
+# (completed lost-rank recovery cycles of the ElasticOrchestrator,
+# stamped by `MetricsLogger(fleet=orch)`), `fleet_dp` (the topology
+# currently training — shrinks at each elastic resume),
+# `fleet_resume_ok` (bench's kill→resume cycle verdict).  All
+# OPTIONAL, never-null when present; `fleet_` joins the reserved
+# scalar prefixes.
+SCHEMA_VERSION = 8
 
 # field -> (python type, finite_required).  loss_scale may legitimately
 # be large but is finite; grad/update norms are inf/nan ON overflow
@@ -154,8 +164,17 @@ OPTIONAL_SCHEMA = {
     "serve_queue_wait_p99_ms": (float, False),
     "serve_queue_wait_max_ms": (float, False),
     "serve_slo_ok": (bool, False),
+    # v8 (ISSUE 11): fleet fault tolerance.  Barrier seconds appear
+    # only on a multi-host process 0 that committed; fleet_* appear
+    # only when an ElasticOrchestrator is attached (fleet=) or bench's
+    # resume cycle ran — never null.
+    "ckpt_commit_barrier_s": (float, False),
+    "fleet_resumes": (int, False),
+    "fleet_dp": (int, False),
+    "fleet_resume_ok": (bool, False),
 }
-_OPTIONAL_PREFIXES = ("compile_", "hbm_", "comms_", "serve_", "ckpt_")
+_OPTIONAL_PREFIXES = ("compile_", "hbm_", "comms_", "serve_", "ckpt_",
+                      "fleet_")
 
 
 def validate_record(record: dict, prev_step: Optional[int] = None) -> None:
@@ -247,7 +266,8 @@ class MetricsLogger:
                  memory: bool = False,
                  memory_device=None,
                  ckpt=None,
-                 serve=None):
+                 serve=None,
+                 fleet=None):
         self.sinks = list(sinks)
         self.flops_per_step = flops_per_step
         # None resolves the per-chip peak from the device kind (ISSUE 5
@@ -280,6 +300,12 @@ class MetricsLogger:
         # All host-side state the scheduler already owns: stamping
         # adds zero device syncs.
         self.serve = serve
+        # fleet: a checkpoint.ElasticOrchestrator (anything with a
+        # .stats() of fleet_* scalars) — every record gains the v8
+        # `fleet_resumes` / `fleet_dp` fields, so an elastic topology
+        # shrink is visible in the same stream as the step-times it
+        # changed.
+        self.fleet = fleet
         # taps=True: log_step(…, taps=tap_state) folds the flight
         # recorder's per-layer stat planes into each record as compact
         # summary fields (tap_fwd_absmax / tap_grad_absmax /
@@ -381,6 +407,8 @@ class MetricsLogger:
             record.update(self.ckpt.stats())
         if self.serve is not None:
             record.update(self.serve.serve_record())
+        if self.fleet is not None:
+            record.update(self.fleet.stats())
         if extra:
             record.update(extra)
         for s in self.sinks:

@@ -44,6 +44,15 @@ class StragglerDetector:
         self.steps_seen = 0
         self.last: Optional[dict] = None
 
+    def reset(self) -> None:
+        """Clear all history (counters, last summary, rank count).  The
+        elastic-resume rebuild hook: after a dp=N→M topology change the
+        rank count legitimately differs, and `update` otherwise refuses
+        a mid-run rank-count change."""
+        self._consecutive = None
+        self.steps_seen = 0
+        self.last = None
+
     def update(self, timings) -> dict:
         """Fold one step's gathered (n_ranks, k) timing matrix in.
 

@@ -671,6 +671,97 @@ def _stamp_ckpt(result, cycle):
     result["ckpt_roundtrip_ok"] = bool(cycle["roundtrip_ok"])
 
 
+def _fleet_cycle(on_tpu):
+    """Multi-host commit + kill + elastic-resume mini-cycle (ISSUE 11):
+    two emulated hosts commit one ZeRO-layout checkpoint through the
+    sub-manifest → rank-0 barrier protocol, a half-fleet commit is
+    REFUSED, and the `ElasticOrchestrator` drives one lost-rank
+    recovery whose re-shard restore must reproduce the committed
+    canonical flat bitwise.  Protocol-level (host arrays, no jit) so
+    the stamp is cheap on every backend; the full fleet gate with real
+    process kills is `scripts/fleet_probe.py`.  Stamps, via
+    _stamp_fleet: `fleet_resume_ok`, `fleet_resumes`,
+    `ckpt_commit_barrier_s` (schema v8)."""
+    import shutil
+    import tempfile
+
+    from apex_tpu.checkpoint import ElasticOrchestrator
+    from apex_tpu.checkpoint import multihost as MH
+    from apex_tpu.checkpoint import sharded as S
+    from apex_tpu.checkpoint.chaos import RankLostError
+
+    dp = 4
+    n = (1 << 20 if on_tpu else 1 << 12)
+    layout = {"align": 64, "total": n, "n_tensors": 1, "num_shards": dp,
+              "n_buckets": 1, "bucket_totals": [n], "bucket_padded": [n],
+              "master_dtype": "float32"}
+    rng = np.random.RandomState(11)
+    flat = rng.randn(n).astype(np.float32)
+    shards = {r: flat[r * n // dp:(r + 1) * n // dp] for r in range(dp)}
+    tmp = tempfile.mkdtemp(prefix="apex_fleet_bench_")
+    try:
+        # 2-host commit: host 1's half, then host 0 commits
+        MH.save_sharded_multihost(
+            tmp, 1, {"params_shard": ("sharded",
+                                      {2: shards[2], 3: shards[3]})},
+            process_id=1, num_processes=2, flat_layout=layout)
+        _, barrier_s = MH.save_sharded_multihost(
+            tmp, 1, {"params_shard": ("sharded",
+                                      {0: shards[0], 1: shards[1]})},
+            process_id=0, num_processes=2, flat_layout=layout,
+            timeout_s=30.0)
+        # half-fleet commit of step 2 must be REFUSED (host 1 "dead")
+        refused = False
+        try:
+            MH.save_sharded_multihost(
+                tmp, 2, {"params_shard": ("sharded",
+                                          {0: shards[0], 1: shards[1]})},
+                process_id=0, num_processes=2, flat_layout=layout,
+                timeout_s=0.2, poll_s=0.02)
+        except MH.MultihostCommitError:
+            refused = True
+        refused = refused and S.latest_committed_step(tmp) == 1
+
+        # one lost-rank recovery: session 1 dies, session 2 re-shards
+        # the committed step to dp=2 and hands back the canonical flat
+        dst = dict(layout, num_shards=2)
+
+        def build(new_dp, resume_step, attempt):
+            def session():
+                if new_dp == dp:
+                    raise RankLostError("rank 3 lost (bench cycle)",
+                                        rank=3)
+                p = S.step_dir(tmp, resume_step)
+                m = S.read_manifest(p)
+                host = S.load_field_host(p, m, "params_shard",
+                                         check_crc=True)
+                re2 = S.reshard(host, m["flat_layout"], dst)
+                return S.canonical_flat(list(np.split(re2, 2)), dst)
+            return session
+
+        orch = ElasticOrchestrator(tmp, build, initial_dp=dp,
+                                   choose_dp=lambda d, e: 2)
+        canon = orch.run()
+        resume_ok = bool(np.array_equal(canon, flat))
+        return {"dp": dp, "n_hosts": 2,
+                "barrier_s": round(barrier_s, 6),
+                "refused_ok": bool(refused),
+                "resumes": orch.stats()["fleet_resumes"],
+                "resume_ok": resume_ok}
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def _stamp_fleet(result, cycle):
+    """Flat v8 `fleet_*` / barrier scalars (prefix JSON-scalar-reserved,
+    the `ckpt_` rule) + the full cycle dict under `fleet`."""
+    result["fleet"] = cycle
+    result["fleet_resume_ok"] = bool(cycle["resume_ok"]
+                                     and cycle["refused_ok"])
+    result["fleet_resumes"] = int(cycle["resumes"])
+    result["ckpt_commit_barrier_s"] = float(cycle["barrier_s"])
+
+
 def _adam_1b_step_ms(on_tpu):
     """Fused flat-buffer Adam step at 1B params (fp32 p/m/v, bf16
     grads) — the large-param optimizer north star (BASELINE.md;
@@ -946,6 +1037,15 @@ def main():
         _stamp_ckpt(result, cycle)
     except Exception as e:
         result["ckpt_error"] = repr(e)[:120]
+    # fleet fault tolerance (ISSUE 11): multi-host commit barrier +
+    # refusal + one orchestrated lost-rank resume, stamped as flat
+    # fleet_* v8 scalars (+ the dict under `fleet`)
+    try:
+        with _timed(durations, "fleet_cycle"):
+            fcycle = _retry(_fleet_cycle, on_tpu)
+        _stamp_fleet(result, fcycle)
+    except Exception as e:
+        result["fleet_error"] = repr(e)[:120]
     try:
         with _timed(durations, "long_context_32k"):
             lc_ms, lc_tps = _retry(_long_context_32k, on_tpu)

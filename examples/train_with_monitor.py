@@ -222,6 +222,13 @@ def main():
             if restored_scaler is not None:
                 scaler_box[0] = restored_scaler
             start_step = int(manifest["step"])
+            # model state rides the SAME manifest (ISSUE 11): restore
+            # the data-stream RNG key so the resumed run consumes the
+            # batches the preempted one never saw — one commit covers
+            # the whole run, nothing goes through a side channel
+            model_state = manager.restore_model_state(step=start_step)
+            if "rng_key" in model_state:
+                key = jnp.asarray(model_state["rng_key"])
             print(f"resumed from committed checkpoint step {start_step}")
         else:
             print(f"--resume: no committed checkpoint under "
@@ -252,7 +259,9 @@ def main():
             timers.write(["train-step"], logger.writer, i, reset=True)
             if manager is not None:
                 manager.maybe_save(start_step + i + 1, opt_state_box[0],
-                                   scaler_box[0])
+                                   scaler_box[0],
+                                   model_state={"rng_key":
+                                                np.asarray(key)})
             if args.crash_at is not None and i == args.crash_at:
                 raise RuntimeError(
                     f"injected crash at step {i} (--crash-at)")

@@ -252,7 +252,7 @@ def test_kill_mid_save_never_commits(tmp_path):
     mgr.save(1, state)
     assert mgr.last_committed_step == 1
 
-    for point in chaos.POINTS:
+    for point in chaos.CKPT_POINTS:  # the single-host writer's points
         with chaos.preempt_at(point):
             with pytest.raises(chaos.SimulatedPreemption):
                 mgr.save(2, state)
@@ -289,7 +289,7 @@ def test_overwrite_of_committed_step_is_staged(tmp_path):
         "params_shard": ("sharded",
                          list(np.split(np.full(16, 9.0, np.float32), 2))),
         "step": ("replicated", np.asarray(5, np.int32))}
-    for point in chaos.POINTS:
+    for point in chaos.CKPT_POINTS:  # the single-host writer's points
         with chaos.preempt_at(point):
             with pytest.raises(chaos.SimulatedPreemption):
                 save_sharded(str(tmp_path), 5, new_fields,

@@ -1,0 +1,703 @@
+"""Fleet fault tolerance (ISSUE 11): multi-host checkpoint commit
+(sub-manifest → rank-0 barrier protocol, stale-race resolution, prune
+safety), the elastic-resume orchestration loop (recovery cycle,
+escalation paths, retry/backoff), watchdog flap recovery, the
+multiproc launcher's failure propagation, schema-v8 telemetry stamps,
+and the `scripts/fleet_probe.py` CI gates (fixture selftest + a real
+2-process × 2-device kill/resume smoke)."""
+
+import json
+import os
+import pathlib
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from apex_tpu.checkpoint import (
+    CheckpointManager,
+    ElasticOrchestrator,
+    EscalationError,
+    IncompleteCheckpointError,
+    MultihostCommitError,
+    RetryPolicy,
+    chaos,
+    latest_committed_step,
+    load_model_state,
+    pack_model_state,
+    read_manifest,
+    restore_sharded,
+    unpack_model_state,
+    verify_shards,
+)
+from apex_tpu.checkpoint import multihost as MH
+from apex_tpu.checkpoint import sharded as S
+from apex_tpu.checkpoint.chaos import LostRankWatchdog, RankLostError
+from apex_tpu.monitor.trace.straggler import StragglerDetector
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+
+LAYOUT4 = {"align": 1, "total": 16, "n_tensors": 2, "num_shards": 4,
+           "n_buckets": 1, "bucket_totals": [16], "bucket_padded": [16],
+           "master_dtype": "float32"}
+FLAT = np.arange(16, dtype=np.float32)
+SHARDS = {r: FLAT[r * 4:(r + 1) * 4] for r in range(4)}
+
+
+def _commit_two_hosts(tmp, step, *, attempt=0, model_state=None):
+    """Host 1 writes ranks 2-3, host 0 writes ranks 0-1 (+replicated)
+    and commits.  Returns (step_dir, barrier_s)."""
+    MH.save_sharded_multihost(
+        tmp, step,
+        {"params_shard": ("sharded", {2: SHARDS[2], 3: SHARDS[3]})},
+        process_id=1, num_processes=2, attempt=attempt,
+        flat_layout=LAYOUT4)
+    fields = {"params_shard": ("sharded", {0: SHARDS[0], 1: SHARDS[1]}),
+              "count": ("replicated", np.asarray(step, np.int64))}
+    if model_state:
+        fields.update(pack_model_state(model_state))
+    return MH.save_sharded_multihost(
+        tmp, step, fields, process_id=0, num_processes=2,
+        attempt=attempt, flat_layout=LAYOUT4, timeout_s=10.0)
+
+
+# ---------------------------------------------------------------------------
+# multi-host commit protocol
+# ---------------------------------------------------------------------------
+
+def test_multihost_commit_atomicity_and_merge(tmp_path):
+    """A sub-manifest alone is INVISIBLE; the rank-0 global manifest is
+    the single source of truth; the merged manifest restores the
+    canonical flat bitwise and carries model state + barrier stamp."""
+    tmp = str(tmp_path)
+    MH.save_sharded_multihost(
+        tmp, 5,
+        {"params_shard": ("sharded", {2: SHARDS[2], 3: SHARDS[3]})},
+        process_id=1, num_processes=2, flat_layout=LAYOUT4)
+    assert latest_committed_step(tmp) is None  # half-fleet: invisible
+    p, barrier_s = _commit_two_hosts(
+        tmp, 5, model_state={"rng": np.asarray([1, 2], np.uint32),
+                             "bn": {"mean": np.ones(3, np.float32)}})
+    assert barrier_s >= 0.0
+    assert latest_committed_step(tmp) == 5
+    verify_shards(p)  # single-host validation reads the merged manifest
+    m = read_manifest(p)
+    assert m["multihost"] == {"num_processes": 2, "hosts": [0, 1]}
+    host = S.load_field_host(p, m, "params_shard", check_crc=True)
+    assert np.array_equal(S.canonical_flat(host, LAYOUT4), FLAT)
+    ms = load_model_state(tmp, 5)
+    assert np.array_equal(ms["rng"], [1, 2])
+    assert np.array_equal(ms["bn"]["mean"], np.ones(3))
+
+
+def test_multihost_barrier_refuses_missing_and_stale(tmp_path):
+    """The barrier times out REFUSING (named host) on a missing
+    sub-manifest, and a stale attempt token is never mixed in."""
+    tmp = str(tmp_path)
+    d = S.step_dir(tmp, 7)
+    sub = MH.write_host_shards(
+        d, 7, {"params_shard": ("sharded", {0: SHARDS[0]})},
+        host=0, num_processes=2)
+    MH.publish_submanifest(d, sub)
+    with pytest.raises(MultihostCommitError, match="host 1.*no sub"):
+        MH.gather_submanifests(d, 2, step=7, timeout_s=0.2, poll_s=0.02)
+    assert latest_committed_step(tmp) is None
+    # stale attempt: host 0 published attempt 0; a retry at attempt 1
+    # must not accept it
+    with pytest.raises(MultihostCommitError, match="attempt 0 != 1"):
+        MH.gather_submanifests(d, 1, step=7, attempt=1, timeout_s=0.2,
+                               poll_s=0.02)
+    # crc skew (a write in flight / torn file) is not-ready, → refusal
+    fn = sub["fields"]["params_shard"]["files"][0]["file"]
+    with open(os.path.join(d, fn), "r+b") as f:
+        f.write(b"\xff\xff")
+    with pytest.raises(MultihostCommitError, match="crc mismatch"):
+        MH.gather_submanifests(d, 1, step=7, timeout_s=0.2, poll_s=0.02)
+
+
+def test_multihost_merge_coverage_teeth(tmp_path):
+    """Rank overlap, rank gaps, and non-rank-0 replicated fields are
+    refused by name — a torn fleet never merges."""
+    sub0 = MH.write_host_shards(
+        S.step_dir(str(tmp_path), 1), 1,
+        {"p": ("sharded", {0: SHARDS[0], 1: SHARDS[1]})},
+        host=0, num_processes=2)
+    dup = MH.write_host_shards(
+        S.step_dir(str(tmp_path), 2), 1,
+        {"p": ("sharded", {1: SHARDS[1], 2: SHARDS[2], 3: SHARDS[3]})},
+        host=1, num_processes=2)
+    with pytest.raises(MultihostCommitError, match="rank 1.*two hosts"):
+        MH.merge_submanifests([sub0, dup], step=1, num_shards=4)
+    with pytest.raises(MultihostCommitError, match="missing"):
+        MH.merge_submanifests([sub0], step=1, num_shards=4)
+    with pytest.raises(ValueError, match="rank-0 state"):
+        MH.write_host_shards(
+            S.step_dir(str(tmp_path), 3), 1,
+            {"c": ("replicated", np.zeros(2))}, host=1, num_processes=2)
+
+
+def test_stale_submanifest_race_resolves_to_committed_step(tmp_path):
+    """Satellite: a straggler host's stale step_{k+1} directory (shards
+    + sub-manifest, NO global manifest) next to a committed step k
+    resolves to k on every host, and prune never deletes the in-flight
+    staging directory of a NEWER step another host is still writing."""
+    tmp = str(tmp_path)
+    _commit_two_hosts(tmp, 4)
+    # host 1 raced ahead: its half of step 5 is on disk, host 0 never
+    # committed (died / still writing)
+    MH.save_sharded_multihost(
+        tmp, 5,
+        {"params_shard": ("sharded", {2: SHARDS[2], 3: SHARDS[3]})},
+        process_id=1, num_processes=2, flat_layout=LAYOUT4)
+    assert latest_committed_step(tmp) == 4  # on every host: disk truth
+    # restore resolves to the committed step, not the stale partial
+    m = read_manifest(S.step_dir(tmp, 4))
+    assert m["step"] == 4
+    # prune keeps the newest commit AND host 1's in-flight step 5
+    S.prune(tmp, keep=1)
+    assert latest_committed_step(tmp) == 4
+    assert os.path.exists(
+        MH.submanifest_path(S.step_dir(tmp, 5), 1))
+    # once step 5 commits, a later prune may clear step 4 — and the
+    # stale-looking sub-manifests of COMMITTED steps stay harmless
+    _, _ = MH.save_sharded_multihost(
+        tmp, 5, {"params_shard": ("sharded",
+                                  {0: SHARDS[0], 1: SHARDS[1]}),
+                 "count": ("replicated", np.asarray(5, np.int64))},
+        process_id=0, num_processes=2, flat_layout=LAYOUT4,
+        timeout_s=10.0)
+    assert latest_committed_step(tmp) == 5
+    S.prune(tmp, keep=1)
+    assert latest_committed_step(tmp) == 5
+    assert not os.path.isdir(S.step_dir(tmp, 4))
+
+
+def test_multihost_overwrite_refused(tmp_path):
+    tmp = str(tmp_path)
+    _commit_two_hosts(tmp, 2)
+    with pytest.raises(S.CheckpointError, match="multi-host overwrite"):
+        MH.save_sharded_multihost(
+            tmp, 2, {"params_shard": ("sharded", {0: SHARDS[0]})},
+            process_id=0, num_processes=2, flat_layout=LAYOUT4)
+
+
+# ---------------------------------------------------------------------------
+# CheckpointManager in multi-host mode (stub optimizer, no jit)
+# ---------------------------------------------------------------------------
+
+class _StubZeRO:
+    """state_partition_specs/shard_layout of a 4-shard flat optimizer
+    without any device work — exercises the manager's snapshot split."""
+    num_shards = 4
+    axis_name = "dp"
+
+    def state_partition_specs(self):
+        from jax.sharding import PartitionSpec as P
+        return {"params_shard": P("dp"), "count": P()}
+
+    def shard_layout(self):
+        return dict(LAYOUT4)
+
+
+def test_manager_multihost_split_and_stats(tmp_path):
+    """Each host's manager writes only its local ranks + process 0 the
+    replicated fields; process 0 stamps ckpt_commit_barrier_s; the
+    committed manifest restores and model state round-trips."""
+    tmp = str(tmp_path)
+    state = {"params_shard": FLAT.copy(),
+             "count": np.asarray(3, np.int64)}
+    m1 = CheckpointManager(tmp, _StubZeRO(), every_n_steps=1,
+                           process_id=1, num_processes=2,
+                           async_write=False, barrier_timeout_s=10.0)
+    m1.save(3, state)
+    assert latest_committed_step(tmp) is None
+    assert "ckpt_commit_barrier_s" not in m1.stats()
+    m0 = CheckpointManager(tmp, _StubZeRO(), every_n_steps=1,
+                           process_id=0, num_processes=2,
+                           async_write=False, barrier_timeout_s=10.0)
+    m0.save(3, state, model_state={"rng_key": np.asarray([9], np.uint32)})
+    assert latest_committed_step(tmp) == 3
+    st = m0.stats()
+    assert st["ckpt_commit_barrier_s"] >= 0.0
+    assert st["ckpt_last_step"] == 3
+    p = S.step_dir(tmp, 3)
+    m = read_manifest(p)
+    # file set: 4 rank files (2 per host) + replicated count + model
+    files = sorted(f["file"] for e in m["fields"].values()
+                   for f in e["files"])
+    assert files == ["count.bin", "model.rng_key.bin",
+                     "params_shard.rank000.bin", "params_shard.rank001.bin",
+                     "params_shard.rank002.bin", "params_shard.rank003.bin"]
+    host = S.load_field_host(p, m, "params_shard")
+    assert np.array_equal(S.canonical_flat(host, LAYOUT4), FLAT)
+    assert np.array_equal(m0.restore_model_state(3)["rng_key"], [9])
+    # restore_sharded never leaks model.* fields into optimizer state
+    stub = _StubZeRO()
+    restored, scaler, manifest = restore_sharded(tmp, stub)
+    assert set(restored) == {"params_shard", "count"}
+    assert np.array_equal(np.asarray(restored["params_shard"]), FLAT)
+
+
+def test_manager_env_fallback_per_field(tmp_path, monkeypatch):
+    """Each launcher id falls back to the env INDEPENDENTLY: passing
+    only num_processes must still pick up APEX_TPU_PROCESS_ID, or
+    every host believes it is process 0 (review finding)."""
+    monkeypatch.setenv("APEX_TPU_PROCESS_ID", "1")
+    monkeypatch.setenv("APEX_TPU_NUM_PROCESSES", "2")
+    m = CheckpointManager(str(tmp_path), _StubZeRO(), num_processes=2)
+    assert (m.process_id, m.num_processes) == (1, 2)
+    m = CheckpointManager(str(tmp_path), _StubZeRO(), process_id=0)
+    assert (m.process_id, m.num_processes) == (0, 2)
+    m = CheckpointManager(str(tmp_path), _StubZeRO())
+    assert (m.process_id, m.num_processes) == (1, 2)
+
+
+def test_merge_refuses_unknown_shard_count():
+    """Without num_shards/flat_layout the merge must REFUSE rather
+    than guess n from the highest rank seen — a missing-tail-rank torn
+    fleet would otherwise commit as 'complete' (review finding)."""
+    import tempfile
+    import shutil
+    tmp = tempfile.mkdtemp()
+    try:
+        sub = MH.write_host_shards(
+            S.step_dir(tmp, 1), 1,
+            {"p": ("sharded", {0: SHARDS[0], 1: SHARDS[1]})},
+            host=0, num_processes=2)
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    with pytest.raises(MultihostCommitError,
+                       match="cannot validate rank coverage"):
+        MH.merge_submanifests([sub], step=1)
+
+
+def test_manager_sync_failure_not_resurfaced(tmp_path):
+    """A synchronous save that raised (refused barrier) must not leave
+    a stale error behind: the NEXT save's wait() re-raising it would
+    silently skip that write — a recovered fleet would lose its next
+    resume point (review finding, reproduced)."""
+    tmp = str(tmp_path)
+    state = {"params_shard": FLAT.copy(),
+             "count": np.asarray(1, np.int64)}
+    m0 = CheckpointManager(tmp, _StubZeRO(), process_id=0,
+                           num_processes=2, async_write=False,
+                           barrier_timeout_s=0.2)
+    with pytest.raises(MultihostCommitError):
+        m0.save(4, state)  # host 1 never publishes: refused
+    # the failure was surfaced ABOVE; the next save must run —
+    # host 1 publishes first this time, so step 8 commits
+    m1 = CheckpointManager(tmp, _StubZeRO(), process_id=1,
+                           num_processes=2, async_write=False)
+    m1.save(8, state)
+    m0.barrier_timeout_s = 10.0
+    m0.save(8, state)
+    assert latest_committed_step(tmp) == 8
+
+
+def test_manager_multihost_attempt_token_isolation(tmp_path):
+    """A retry of the same step must bump the attempt token: process
+    0 at attempt 1 refuses host 1's stale attempt-0 sub-manifest."""
+    tmp = str(tmp_path)
+    state = {"params_shard": FLAT.copy(),
+             "count": np.asarray(1, np.int64)}
+    m1 = CheckpointManager(tmp, _StubZeRO(), process_id=1,
+                           num_processes=2, async_write=False,
+                           attempt=0)
+    m1.save(6, state)
+    m0 = CheckpointManager(tmp, _StubZeRO(), process_id=0,
+                           num_processes=2, async_write=False,
+                           attempt=1, barrier_timeout_s=0.3)
+    with pytest.raises(MultihostCommitError, match="attempt 0 != 1"):
+        m0.save(6, state)
+    assert latest_committed_step(tmp) is None
+
+
+# ---------------------------------------------------------------------------
+# watchdog flap recovery + orchestrator loop
+# ---------------------------------------------------------------------------
+
+def _timings(dp, slow_rank=None, skew=3.0):
+    t = np.full((dp, 1), 0.1)
+    if slow_rank is not None:
+        t[slow_rank, 0] = 0.1 * skew
+    return t
+
+
+def test_watchdog_flap_recovery_resets_counter():
+    """Satellite: a rank that recovers (skew back under threshold)
+    resets to ZERO consecutive flags — it is never left one slow step
+    away from a spurious RankLostError."""
+    det = StragglerDetector(threshold=1.5, patience=1)
+    wd = LostRankWatchdog(det, deadline=4)
+    for _ in range(3):                       # deadline-1 slow steps
+        wd.check(_timings(4, slow_rank=2))
+    wd.check(_timings(4))                    # recovery step
+    wd.check(_timings(4, slow_rank=2))       # slow again: counter is 1
+    assert det._consecutive[2] == 1          # reset actually happened
+    # without recovery the 4th consecutive flag raises, with the rank
+    # and resume point carried structurally
+    for _ in range(2):
+        wd.check(_timings(4, slow_rank=2))
+    with pytest.raises(RankLostError) as ei:
+        wd.check(_timings(4, slow_rank=2))
+    assert ei.value.rank == 2
+    assert ei.value.last_committed is None
+
+
+def test_watchdog_stale_summary_and_reset():
+    """check() judges each detector summary ONCE: polling between
+    updates can neither re-raise on stale data nor double-count; and
+    reset() clears history so an elastic dp change doesn't trip the
+    detector's rank-count guard."""
+    det = StragglerDetector(threshold=1.5, patience=1)
+    wd = LostRankWatchdog(det, deadline=3)
+    wd.check(_timings(4, slow_rank=1))
+    wd.check(_timings(4, slow_rank=1))
+    # two stale re-checks of the same summary: no count, no raise
+    assert wd.check()["flagged"][0]["consecutive"] == 2
+    assert wd.check() is not None
+    with pytest.raises(RankLostError):
+        wd.check(_timings(4, slow_rank=1))
+    wd.reset()
+    # a rank-count change after reset folds cleanly (dp=4 → dp=2)
+    assert wd.check(_timings(2)) is not None
+
+
+def test_orchestrator_recovery_cycle(tmp_path):
+    """Lost rank → dump naming the resume point → rebuild at the
+    surviving topology → resume: one full cycle with a committed
+    checkpoint on disk, stats/events/watchdog-reset all observable."""
+    tmp = str(tmp_path)
+    S.save_sharded(tmp, 4, {"params_shard": (
+        "sharded", list(np.split(FLAT, 4))),
+        "count": ("replicated", np.asarray(4, np.int64))},
+        flat_layout=LAYOUT4)
+
+    dumps = []
+
+    class _Recorder:
+        def dump(self, reason, oom=False):
+            dumps.append(reason)
+
+    resets = []
+
+    class _WD:
+        def reset(self):
+            resets.append(True)
+
+    calls = []
+
+    def build(dp, resume_step, attempt):
+        calls.append((dp, resume_step, attempt))
+
+        def session():
+            if dp == 4:
+                raise RankLostError("rank 3 lost", rank=3,
+                                    last_committed=4)
+            return f"done@dp{dp}"
+        return session
+
+    orch = ElasticOrchestrator(tmp, build, initial_dp=4,
+                               choose_dp=lambda dp, e: 2,
+                               recorder=_Recorder(), watchdog=_WD())
+    assert orch.run() == "done@dp2"
+    assert calls == [(4, 4, 0), (2, 4, 1)]
+    assert orch.stats() == {"fleet_resumes": 1, "fleet_dp": 2}
+    assert resets == [True]
+    assert len(dumps) == 1 and "last committed checkpoint: step 4" in \
+        dumps[0]
+    assert orch.events[0]["kind"] == "rank_lost"
+    assert orch.events[0]["rank"] == 3
+    assert orch.events[0]["resume_step"] == 4
+
+
+def test_orchestrator_escalation_paths(tmp_path):
+    """Hard escalation by name: no committed checkpoint; resume budget
+    exhausted; transient build failures past the retry policy (with
+    backoff observable through the injected sleep)."""
+    empty = str(tmp_path / "empty")
+    os.makedirs(empty)
+
+    def build_doomed(dp, resume_step, attempt):
+        def session():
+            raise RankLostError("rank 1 lost", rank=1)
+        return session
+
+    with pytest.raises(EscalationError, match="NO committed checkpoint"):
+        ElasticOrchestrator(empty, build_doomed, initial_dp=2).run()
+
+    ckpt = str(tmp_path / "ckpt")
+    S.save_sharded(ckpt, 1, {"c": ("replicated", np.zeros(2))})
+    with pytest.raises(EscalationError, match="resume budget exhausted"):
+        ElasticOrchestrator(ckpt, build_doomed, initial_dp=4,
+                            max_resumes=0).run()
+
+    sleeps = []
+
+    def build_flaky(dp, resume_step, attempt):
+        raise ConnectionError("coordinator not up yet")
+
+    with pytest.raises(EscalationError, match="transient errors"):
+        ElasticOrchestrator(
+            ckpt, build_flaky, initial_dp=2,
+            retry=RetryPolicy(attempts=3, backoff_s=0.01),
+            sleep=sleeps.append).run()
+    assert sleeps == [0.01, 0.02]  # exponential backoff, attempts-1
+
+    # a NON-transient build error propagates untouched
+    def build_broken(dp, resume_step, attempt):
+        raise ValueError("bad config")
+
+    with pytest.raises(ValueError, match="bad config"):
+        ElasticOrchestrator(ckpt, build_broken, initial_dp=2).run()
+
+
+def test_orchestrator_transient_then_recovers(tmp_path):
+    """One ConnectionError then a clean session: retried at the same
+    topology, zero resumes spent."""
+    ckpt = str(tmp_path)
+    S.save_sharded(ckpt, 1, {"c": ("replicated", np.zeros(2))})
+    tries = []
+
+    def build(dp, resume_step, attempt):
+        tries.append(dp)
+        if len(tries) == 1:
+            raise ConnectionError("transient")
+        return lambda: "ok"
+
+    orch = ElasticOrchestrator(ckpt, build, initial_dp=2,
+                               retry=RetryPolicy(backoff_s=0.0),
+                               sleep=lambda s: None)
+    assert orch.run() == "ok"
+    assert tries == [2, 2]
+    assert orch.stats() == {"fleet_resumes": 0, "fleet_dp": 2}
+
+
+# ---------------------------------------------------------------------------
+# chaos env arming + multiproc launcher
+# ---------------------------------------------------------------------------
+
+def test_chaos_arm_from_env_proc_filtering():
+    try:
+        env = {"APEX_TPU_CHAOS": "host.before_barrier,rank.lost_at_step:3",
+               "APEX_TPU_CHAOS_PROC": "1", "APEX_TPU_PROCESS_ID": "0"}
+        assert chaos.arm_from_env(env) == []          # wrong process
+        env["APEX_TPU_PROCESS_ID"] = "1"
+        assert chaos.arm_from_env(env) == [
+            ("host.before_barrier", 1), ("rank.lost_at_step", 3)]
+        # armed for real: 3rd check fires
+        chaos.check("rank.lost_at_step")
+        chaos.check("rank.lost_at_step")
+        with pytest.raises(chaos.SimulatedPreemption):
+            chaos.check("rank.lost_at_step")
+        with pytest.raises(chaos.SimulatedPreemption):
+            chaos.check("host.before_barrier")
+        # alternate var (the probe's save-time staging) + bad point
+        assert chaos.arm_from_env({"X": "host.before_barrier"},
+                                  var="X") == [("host.before_barrier", 1)]
+        chaos.disarm_all()
+        with pytest.raises(ValueError, match="unknown fail point"):
+            chaos.arm_from_env({"APEX_TPU_CHAOS": "nope.nope"})
+    finally:
+        chaos.disarm_all()
+
+
+def test_wait_fleet_propagates_first_failure_and_terminates():
+    """Satellite: a dead child no longer leaves siblings hanging — the
+    first nonzero exit propagates and the sleeper is terminated well
+    before its own runtime."""
+    from apex_tpu.parallel.multiproc import wait_fleet
+    t0 = time.monotonic()
+    procs = [
+        subprocess.Popen([sys.executable, "-c",
+                          "import time; time.sleep(60)"]),
+        subprocess.Popen([sys.executable, "-c",
+                          "import sys; sys.exit(7)"]),
+    ]
+    rc = wait_fleet(procs, timeout=30.0, grace=0.0)
+    assert rc == 7
+    assert all(p.poll() is not None for p in procs)
+    assert time.monotonic() - t0 < 20.0
+
+
+def test_wait_fleet_grace_lets_survivors_finish(tmp_path):
+    """With grace, a surviving child completes its own work after a
+    sibling dies (the fleet probe's commit-or-refuse observation)."""
+    from apex_tpu.parallel.multiproc import wait_fleet
+    marker = str(tmp_path / "survivor_done")
+    procs = [
+        subprocess.Popen([sys.executable, "-c", "import sys; sys.exit(5)"]),
+        subprocess.Popen([sys.executable, "-c",
+                          "import time, pathlib; time.sleep(0.6); "
+                          f"pathlib.Path({marker!r}).write_text('ok')"]),
+    ]
+    rc = wait_fleet(procs, timeout=30.0, grace=15.0)
+    assert rc == 5
+    assert os.path.exists(marker)
+
+
+def test_wait_fleet_timeout_kills_hung_fleet():
+    from apex_tpu.parallel.multiproc import wait_fleet
+    p = subprocess.Popen([sys.executable, "-c",
+                          "import time; time.sleep(60)"])
+    t0 = time.monotonic()
+    assert wait_fleet([p], timeout=0.5, grace=0.0) == 124
+    assert p.poll() is not None
+    assert time.monotonic() - t0 < 15.0
+
+
+# ---------------------------------------------------------------------------
+# schema v8 stamps + model-state pack/unpack
+# ---------------------------------------------------------------------------
+
+def test_pack_unpack_model_state_roundtrip():
+    tree = {"rng": np.asarray([1, 2], np.uint32),
+            "bn": {"mean": np.ones(3), "var": np.zeros(3)}}
+    packed = pack_model_state(tree)
+    assert sorted(packed) == ["model.bn.mean", "model.bn.var",
+                              "model.rng"]
+    flat = {k: v for k, (_, v) in packed.items()}
+    back = unpack_model_state(flat)
+    assert np.array_equal(back["bn"]["var"], np.zeros(3))
+    with pytest.raises(ValueError, match="contains"):
+        pack_model_state({"a.b": np.zeros(1)})
+    with pytest.raises(ValueError, match="empty dict"):
+        pack_model_state({"a": {}})
+
+
+def test_logger_stamps_fleet_and_barrier_fields(tmp_path):
+    """MetricsLogger(fleet=orch) stamps fleet_resumes/fleet_dp and a
+    multihost ckpt stats dict with ckpt_commit_barrier_s validates
+    under schema v8."""
+    import apex_tpu.monitor as monitor
+    from apex_tpu.monitor.logger import validate_record
+
+    class _Fleet:
+        def stats(self):
+            return {"fleet_resumes": 2, "fleet_dp": 3}
+
+    class _Ckpt:
+        def stats(self):
+            return {"ckpt_blocking_s": 0.01, "ckpt_save_s": 0.02,
+                    "ckpt_last_step": 7, "ckpt_bytes": 1024,
+                    "ckpt_commit_barrier_s": 0.005}
+
+    path = str(tmp_path / "m.jsonl")
+    logger = monitor.MetricsLogger([monitor.JSONLSink(path)],
+                                   fleet=_Fleet(), ckpt=_Ckpt())
+    logger.log_step(monitor.init_metrics())
+    logger.close()
+    with open(path) as f:
+        rec = json.loads(f.readline())
+    assert rec["fleet_resumes"] == 2
+    assert rec["fleet_dp"] == 3
+    assert rec["ckpt_commit_barrier_s"] == 0.005
+    validate_record(rec)
+    # fleet_resume_ok (the bench stamp) is schema-legal too
+    rec["fleet_resume_ok"] = True
+    validate_record(rec)
+    rec["fleet_resumes"] = None  # never-null contract
+    with pytest.raises(ValueError):
+        validate_record(rec)
+
+
+def test_bench_fleet_cycle_stamps():
+    """bench.py's protocol-level kill→resume cycle: refusal observed,
+    one orchestrated resume, bitwise canonical — fleet_resume_ok."""
+    import bench
+
+    cycle = bench._fleet_cycle(False)
+    assert cycle["refused_ok"] and cycle["resume_ok"]
+    assert cycle["resumes"] == 1
+    result = {}
+    bench._stamp_fleet(result, cycle)
+    assert result["fleet_resume_ok"] is True
+    assert result["fleet_resumes"] == 1
+    assert result["ckpt_commit_barrier_s"] >= 0.0
+
+
+# ---------------------------------------------------------------------------
+# the standing CI gates (scripts/fleet_probe.py)
+# ---------------------------------------------------------------------------
+
+def _run_script(path, *args, timeout=600, env_extra=None):
+    return subprocess.run(
+        [sys.executable, str(path), *args], capture_output=True,
+        text=True, timeout=timeout,
+        env={**os.environ, "JAX_PLATFORMS": "cpu", **(env_extra or {})})
+
+
+def test_fleet_probe_selftest():
+    """Fixture drift gate: the committed global/sub-manifest fixture
+    still validates, re-merges, and the seeded half-published barrier
+    is refused by name (the selftest's own negative control)."""
+    r = _run_script(ROOT / "scripts" / "fleet_probe.py", "--selftest")
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "fleet_probe --selftest: OK" in r.stdout
+
+
+def test_fleet_kill_resume_smoke(tmp_path):
+    """The tier-1 2-process × 2-device smoke: a REAL fleet commits a
+    multi-host checkpoint, host 1 really dies at
+    host.before_submanifest during a later save, the surviving process
+    0 refuses the torn commit BY NAME, the committed step stays
+    loadable — and an in-process dp=2 resume replays the remaining
+    steps BITWISE against the survivor's trajectory."""
+    sys.path.insert(0, str(ROOT / "scripts"))
+    try:
+        import fleet_probe as FP
+    finally:
+        sys.path.pop(0)
+    from apex_tpu.parallel import multiproc
+
+    ckpt = str(tmp_path / "ckpt")
+    results = str(tmp_path / "results")
+    os.makedirs(ckpt)
+    os.makedirs(results)
+    os.environ["APEX_TPU_CHAOS_SAVE"] = "host.before_submanifest"
+    os.environ["APEX_TPU_CHAOS_PROC"] = "1"
+    try:
+        rc = multiproc.main([
+            "--nproc", "2", "--devices-per-proc", "2",
+            "--coordinator", "127.0.0.1:12461",
+            "--timeout", "240", "--grace", "120",
+            str(ROOT / "scripts" / "fleet_probe.py"), "--worker",
+            "--ckpt-dir", ckpt, "--result-dir", results,
+            "--steps", "4", "--save-at", "2", "--kill-at", "4",
+            "--dp", "2", "--barrier-timeout", "4"])
+    finally:
+        os.environ.pop("APEX_TPU_CHAOS_SAVE", None)
+        os.environ.pop("APEX_TPU_CHAOS_PROC", None)
+    assert rc == FP.KILLED_RC  # host 1 really died
+    # the commit of step 2 survived the kill; step 4 never tore
+    assert latest_committed_step(ckpt) == 2
+    verify_shards(S.step_dir(ckpt, 2))
+    assert "rng_key" in load_model_state(ckpt, 2)
+    # survivor (process 0) finished and REFUSED the torn commit by name
+    with open(os.path.join(results, "proc0.json")) as f:
+        surv = json.load(f)
+    assert surv["refusal"] and "host 1" in surv["refusal"]
+    assert not os.path.exists(os.path.join(results, "proc1.json"))
+    assert surv["steady_recompiles"] == 0
+    # in-process resume at the same dp: the replayed tail is BITWISE
+    # the survivor's trajectory (the orchestrator path, equal topology)
+    def build(dp, resume_step, attempt):
+        seg = FP._build_segment(dp, ckpt, resume_step=resume_step)
+
+        def session():
+            cfg, batch = FP._config()
+            losses, retraces, _ = FP._drive(
+                seg, FP._make_batches(4, batch, cfg.seq_len,
+                                      cfg.vocab_size),
+                resume_step, 4)
+            return losses, retraces
+        return session
+
+    losses, retraces = ElasticOrchestrator(ckpt, build,
+                                           initial_dp=2).run()
+    from apex_tpu.parallel import mesh as M
+    M.destroy_model_parallel()
+    assert retraces == 0
+    assert losses == surv["losses"][2:]
