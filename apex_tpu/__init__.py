@@ -14,7 +14,8 @@ Subpackages (lazily importable):
   optimizers   — fused optimizers over flat buffers (≡ apex.optimizers)
   parallel     — mesh/collectives/DP/SyncBN/LARC (≡ apex.parallel)
   transformer  — TP/SP/PP library (≡ apex.transformer)
-  models       — flagship end-to-end models (ResNet, GPT, BERT)
+  models       — flagship end-to-end models (ResNet, GPT, MoE-GPT, BERT)
+  moe          — expert-parallel Mixture-of-Experts (router/dispatch/layer)
   monitor      — on-device metrics pytree + host sinks + profiler capture
 """
 
@@ -79,6 +80,8 @@ _LAZY_SUBMODULES = {
     # reference name parity (apex/__init__.py lazy subpackages)
     "contrib", "fp16_utils", "models", "monitor", "normalization", "mlp",
     "fused_dense", "multi_tensor_apply", "checkpoint", "rnn",
+    # TPU-native additions
+    "moe", "serve", "lint", "tune",
 }
 
 

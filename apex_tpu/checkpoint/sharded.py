@@ -507,6 +507,23 @@ def load_model_state(directory: str, step: Optional[int] = None, *,
 
 
 def _check_layouts(src: dict, dst: dict) -> None:
+    src_ep = int(src.get("ep_shards", 1))
+    dst_ep = int(dst.get("ep_shards", 1))
+    if src_ep != dst_ep:
+        # refuse BY NAME, never silently concat: an expert-sharded
+        # layout's rank enumeration is (dp-major, ep-minor) over the
+        # combined data axes, and the elastic re-shard contract is
+        # dp-elasticity ONLY — re-laying across the ep axis would
+        # reassign which mesh coordinate holds which expert state
+        # under a contract nothing has validated (ISSUE 13 satellite;
+        # docs/moe.md "Checkpointing expert-sharded state")
+        raise LayoutMismatchError(
+            f"checkpoint flat layout is expert-sharded over "
+            f"ep={src_ep} but the target optimizer's layout carries "
+            f"ep={dst_ep} — re-sharding is elastic in dp only; the "
+            "'ep' axis cannot be re-laid (restore at the original "
+            "expert-parallel size, or gather_state_dict the source "
+            "run into a layout-independent checkpoint first)")
     for key in ("align", "total", "n_tensors", "master_dtype"):
         if src.get(key) != dst.get(key):
             raise LayoutMismatchError(

@@ -25,6 +25,18 @@ plain graph checks:
          may DCE it, but its presence in the traced program means the
          source builds a reduction it never uses — usually a stale
          metrics line still paying a trace.
+  CL206  an `all_to_all` over an unbound or mismatched expert-parallel
+         axis.  Two shapes: (a) an all_to_all over an axis nothing
+         binds/declares — the CL201 hazard with token-routing stakes
+         (both rules fire deliberately: CL201 is the generic check,
+         CL206 carries the dispatch-contract hint); (b) an all_to_all
+         riding the DATA-parallel axis while the mesh carries `ep` —
+         the classic dp/ep transposition typo, which scrambles tokens
+         across data-parallel replicas instead of expert peers and
+         trains — silently — on the wrong experts.  (b) is scoped to
+         dp-riding exchanges only: all_to_alls over other axes (the
+         Ulysses context-parallel head-scatter) are legitimate
+         non-expert traffic even on an ep-carrying mesh.
 """
 
 from __future__ import annotations
@@ -105,6 +117,57 @@ def run(views, *, program: str, config: E.LintConfig) -> List[Finding]:
                              "fix the axis_name typo); a collective "
                              "over the wrong axis reduces the wrong "
                              "ranks"))
+
+            # ---- CL206: all_to_all off the expert-parallel axis ----
+            # the ep axis is special-cased because a wrong-axis
+            # all_to_all is not redundant traffic like CL202 — it is a
+            # silently wrong token exchange.  Two shapes: (a) the
+            # all_to_all names an axis nothing binds/declares; (b) an
+            # ep axis EXISTS (bound or declared) but the exchange
+            # rides a different one.
+            if prim == "all_to_all":
+                known = frozenset(view.axes) | (expected or frozenset())
+                bad = next(
+                    (a for a in axes
+                     if (view.axes and a not in view.axes)
+                     or (expected is not None and a not in expected)),
+                    None)
+                if bad is not None:
+                    # name the axis set of the CHECK that failed (the
+                    # CL201 convention): the bound axes when the
+                    # program doesn't bind it, else the declared mesh
+                    if view.axes and bad not in view.axes:
+                        what, have = "program binds", sorted(view.axes)
+                    else:
+                        what, have = ("declared mesh carries",
+                                      sorted(expected))
+                    findings.append(make_finding(
+                        "CL206", loc,
+                        f"all_to_all exchanges over axis {bad!r} but "
+                        f"the {what} only {have} — the expert "
+                        "dispatch/combine would trade tokens with "
+                        "nonexistent peers",
+                        hint="bind the ep axis in the mesh "
+                             "(initialize_model_parallel(expert_model_"
+                             "parallel_size=...)) or fix the axis "
+                             "name passed to the exchange"))
+                elif ("ep" in known and "ep" not in axes
+                        and "dp" in axes):
+                    # scoped to DP-riding exchanges: an all_to_all
+                    # over cp/tp (Ulysses head-scatter) is legitimate
+                    # non-expert traffic even on an ep-carrying mesh
+                    findings.append(make_finding(
+                        "CL206", loc,
+                        f"all_to_all rides {sorted(axes)} while the "
+                        "mesh carries an expert-parallel 'ep' axis — "
+                        "expert dispatch/combine must exchange over "
+                        "ep; a dp/ep transposition scrambles tokens "
+                        "across data-parallel replicas instead of "
+                        "expert peers",
+                        hint="pass the ep axis (mesh.EP_AXIS) to the "
+                             "exchange, or allowlist if this "
+                             "all_to_all is deliberately non-expert "
+                             "traffic"))
 
             # ---- CL202: psum-of-psum ----
             if prim == "psum":

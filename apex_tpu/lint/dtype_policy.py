@@ -31,6 +31,15 @@ makes the same properties *checkable before execution*:
          value of the same shape — the whole update was computed in
          low precision and the f32 master buffer only stores the
          rounded result (the Apex master-weights guarantee, statically).
+  DP105  a top-k / sort selection over LOW-PRECISION operands — the
+         MoE router contract (apex_tpu.moe): gate logits and their
+         softmax must be fp32 regardless of compute dtype, because
+         bf16's 8-bit mantissa collapses the tiny probability gaps
+         (and the ties) the selection keys on, silently changing
+         which experts train.  The conforming shape keeps the gate
+         GEMM's operands in the compute dtype but accumulates fp32
+         (`preferred_element_type`), so DP101 and DP105 are
+         satisfiable together.
 """
 
 from __future__ import annotations
@@ -47,6 +56,11 @@ _GEMM_PRIMS = ("dot_general", "conv_general_dilated")
 # accumulation precision; cumsum's output size makes the
 # reduction-length heuristic meaningless)
 _ACCUM_REDUCTIONS = ("reduce_sum", "reduce_prod")
+
+# selection primitives the DP105 router-gate check covers (jnp.argsort
+# and lax.top_k both surface as these; approx_top_k is the TPU-native
+# variant)
+_SELECTION_PRIMS = ("top_k", "approx_top_k", "sort")
 
 
 def _gemm_in_dtypes(eqn):
@@ -141,6 +155,23 @@ def run(views, *, program: str, config: E.LintConfig) -> List[Finding]:
                             "is discarded for nothing",
                             hint="drop both casts, or keep the value in "
                                  f"{d1} if the downcast was the intent"))
+
+            # ---- DP105: low-precision top-k / sort selection ----
+            if prim in _SELECTION_PRIMS:
+                sel_dt = next((E.dtype_name(v) for v in eqn.invars
+                               if E.is_float(E.dtype_name(v))), None)
+                if E.is_low_precision(sel_dt):
+                    findings.append(make_finding(
+                        "DP105", loc,
+                        f"{prim} selects over {sel_dt} operands — a "
+                        "router gate softmax/selection in low "
+                        "precision loses ties and the probability "
+                        "gaps the top-k keys on, silently changing "
+                        "which experts train",
+                        hint="compute gate logits with preferred_"
+                             "element_type=float32 and keep the "
+                             "softmax + selection in fp32 (the "
+                             "apex_tpu.moe router contract)"))
 
             # ---- DP103a: low-precision large reduce_sum ----
             if prim in _ACCUM_REDUCTIONS:

@@ -37,12 +37,14 @@ RULES = {
     "DP102": ("warning", "lossy convert_element_type round trip"),
     "DP103": ("warning", "low-precision accumulation in a large reduction"),
     "DP104": ("warning", "master-weight update math not in fp32"),
+    "DP105": ("warning", "router top-k selection over low-precision gates"),
     # collectives
     "CL201": ("error", "collective over an unbound/mismatched mesh axis"),
     "CL202": ("warning", "psum-of-psum redundancy"),
     "CL203": ("warning", "loop-invariant collective inside a scan body"),
     "CL204": ("warning", "fp16 psum operand can overflow under loss scaling"),
     "CL205": ("warning", "dead collective (result unused)"),
+    "CL206": ("error", "all_to_all over an unbound/mismatched ep axis"),
     # donation
     "DN301": ("warning", "state argument not covered by donate_argnums"),
     "DN302": ("error", "runtime donation failed (CompileReport.donation_ok)"),

@@ -4,8 +4,11 @@ examples/ + apex/transformer/testing standalone models)."""
 
 def __getattr__(name):
     import importlib
-    if name in ("resnet", "gpt", "bert"):
+    if name in ("resnet", "gpt", "bert", "moe_gpt"):
         return importlib.import_module(f"apex_tpu.models.{name}")
+    if name in ("MoEGPT", "MoEGPTConfig", "build_moe_train_step"):
+        return getattr(importlib.import_module("apex_tpu.models.moe_gpt"),
+                       name)
     if name in ("ResNet", "resnet50", "resnet18"):
         return getattr(importlib.import_module("apex_tpu.models.resnet"),
                        name)

@@ -1,0 +1,286 @@
+"""MoE-GPT — the expert-parallel flagship (ISSUE 13, ROADMAP item 5).
+
+GPT with every block's dense MLP swapped for `apex_tpu.moe.MoEMLP`:
+fp32 top-k routing, capacity-factor dropping into a static (E, C, H)
+dispatch buffer, ONE all_to_all over the `ep` mesh axis each way, and
+raw-gate-weighted combine.  Everything else — embedding, attention,
+layer norms, vocab-parallel head — is literally the GPT code (this
+class only overrides init / partition_specs / the block's MLP half),
+which is what makes the acceptance anchor provable: at n_experts=1 /
+top_k=1 / capacity_factor=inf / aux_coef=z_coef=0 the whole train
+step is BITWISE the dense GPT step's (tests/test_moe.py).
+
+Training wiring (the `build_moe_train_step` builder, shared by
+bench.py, scripts/lint_step.py, scripts/comms_probe.py and the
+tests): the batch shards over the COMBINED ("dp", "ep") axes — expert
+parallelism lives inside the data-parallel world — and the ZeRO-2
+`DistributedFusedAdam` shards its fp32/bf16 master state over the
+same combined axes (`num_shards=dp*ep`, `axis_name=("dp","ep")`,
+`ep_shards=ep` so the checkpoint layout records the expert sharding
+and `restore_sharded` can refuse an ep re-shard BY NAME).  Gradients
+need no expert-special handling: the combine all_to_all's AD
+transpose already sums each expert's partial grads across its ep
+group, so the step's uniform mean over ("dp", "ep") is exact
+(docs/moe.md, "Why one pmean is enough").
+
+Not supported in this round (loud errors, not silent wrongness):
+sequence_parallel (the token-locality assumption of dispatch breaks)
+and remat (per-block aux stats cross the checkpoint boundary);
+tensor-parallel expert GEMMs are future work — experts replicate
+over tp.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.models.gpt import GPT, GPTConfig
+from apex_tpu.moe.layer import MoEMLP, mean_aux
+from apex_tpu.ops._common import tap as _tap
+from apex_tpu.transformer.tensor_parallel.cross_entropy import (
+    vocab_parallel_cross_entropy,
+)
+from apex_tpu.transformer.tensor_parallel.random import (
+    model_parallel_fold_in,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class MoEGPTConfig(GPTConfig):
+    n_experts: int = 8
+    top_k: int = 2
+    # slots per expert per source shard = ceil(T*k*cf/E) (router.
+    # expert_capacity); inf = never drop (capacity == token count)
+    capacity_factor: float = 1.25
+    # ep size the model computes at (experts sliced by lax.axis_index
+    # ("ep") when > 1); must divide n_experts and match the mesh
+    expert_parallel: int = 1
+    aux_coef: float = 1e-2           # load-balancing loss weight
+    z_coef: float = 1e-3             # router z-loss weight
+    router_block_rows: int = 0       # 0 = tuner/heuristic (moe_router op)
+
+    def __post_init__(self):
+        if self.sequence_parallel:
+            raise ValueError(
+                "MoEGPT does not support sequence_parallel: dispatch "
+                "assumes every local token row is a whole token, and a "
+                "seq-sharded activation is not (route-then-gather is "
+                "future work)")
+        if self.remat:
+            raise ValueError(
+                "MoEGPT does not support remat yet: the per-block MoE "
+                "aux stats cross the jax.checkpoint boundary; run the "
+                "smoke/bench shapes without it")
+        if self.n_experts % max(1, self.expert_parallel):
+            raise ValueError(
+                f"n_experts={self.n_experts} must divide by "
+                f"expert_parallel={self.expert_parallel}")
+
+
+class MoEGPT(GPT):
+    def __init__(self, config: MoEGPTConfig):
+        super().__init__(config)
+        c = config
+        self.moe = [
+            MoEMLP(c.hidden, c.ffn_mult * c.hidden, c.n_experts,
+                   top_k=c.top_k, capacity_factor=c.capacity_factor,
+                   ep_size=c.expert_parallel, init_std=0.02,
+                   proj_init_std=0.02 / float(jnp.sqrt(
+                       2.0 * c.num_layers)),
+                   router_block_rows=c.router_block_rows or None,
+                   tp_axis=c.axis_name)
+            for _ in range(c.num_layers)]
+
+    # ------------------------------ params --------------------------------
+
+    def init(self, key):
+        params = super().init(key)
+        c = self.c
+        moe_key = jax.random.fold_in(key, c.num_layers + 7)
+        for i in range(c.num_layers):
+            bp = params[f"block{i}"]
+            bp.pop("fc1")
+            bp.pop("fc2")
+            bp["moe"] = self.moe[i].init(
+                jax.random.fold_in(moe_key, i), c.dtype)
+        return params
+
+    def partition_specs(self):
+        specs = super().partition_specs()
+        for i in range(self.c.num_layers):
+            bs = specs[f"block{i}"]
+            bs.pop("fc1")
+            bs.pop("fc2")
+            bs["moe"] = self.moe[i].partition_specs()
+        return specs
+
+    # ------------------------------ forward -------------------------------
+
+    def _block(self, i, params, x, key):
+        """GPT's block with the MLP half replaced; returns (x, MoEAux)."""
+        qkv_mod, proj_mod, _, _ = self.blocks[i]
+        bp = params
+        k1 = k2 = k3 = None
+        if key is not None:
+            k1, k2, k3 = jax.random.split(key, 3)
+        h = _tap(self._ln(bp["ln1"], x), f"block{i}/ln1")
+        attn = self._attention(bp, qkv_mod, proj_mod, h, k1)
+        attn = _tap(attn, f"block{i}/attn")
+        x = x + self._dropout(k2, attn)
+        h = _tap(self._ln(bp["ln2"], x), f"block{i}/ln2")
+        m, aux = self.moe[i].apply(bp["moe"], h,
+                                   tap_prefix=f"block{i}/moe",
+                                   cn=("ffn1", "ffn_out"))
+        m = _tap(m, f"block{i}/mlp")
+        x = x + self._dropout(k3, m)
+        return x, aux
+
+    def apply_with_stats(self, params, tokens, key=None):
+        """GPT.apply with per-block MoE aux collection: returns
+        (hidden (S, B, H), MoEAux averaged over blocks)."""
+        c = self.c
+        ids = tokens.T
+        h = self.embed.apply(params["embed"], ids)
+        pos = params["pos_embed"][: tokens.shape[1]][:, None, :]
+        h = h + pos.astype(h.dtype)
+        if key is not None:
+            key = model_parallel_fold_in(key, c.axis_name)
+        auxes = []
+        for i in range(c.num_layers):
+            bk = None if key is None else jax.random.fold_in(key, i)
+            h, aux = self._block(i, params[f"block{i}"], h, bk)
+            auxes.append(aux)
+        return self._ln_final(params, h), mean_aux(auxes)
+
+    def apply(self, params, tokens, key=None):
+        return self.apply_with_stats(params, tokens, key)[0]
+
+    def loss_with_stats(self, params, tokens, labels, key=None):
+        """(total loss, flat fp32 stats dict).  total = CE +
+        aux_coef * load-balance + z_coef * z-loss; a coefficient of
+        exactly 0.0 adds NOTHING to the trace (the bitwise dense-
+        parity anchor needs total == CE to the bit, and x + 0.0 is
+        not an identity for -0.0).  Stats are shard-local values —
+        under the train step's P() out-spec the logger sees one
+        shard's numbers (document-grade, not a collective)."""
+        c = self.c
+        h, aux = self.apply_with_stats(params, tokens, key)
+        logits = self.logits_local(params, h)
+        ce = jnp.mean(vocab_parallel_cross_entropy(
+            logits, labels.T, axis_name=c.axis_name, fused=c.fused_xent))
+        total = ce
+        if c.aux_coef:
+            total = total + jnp.asarray(c.aux_coef, ce.dtype) \
+                * aux.aux_loss.astype(ce.dtype)
+        if c.z_coef:
+            total = total + jnp.asarray(c.z_coef, ce.dtype) \
+                * aux.z_loss.astype(ce.dtype)
+        stats = {"ce_loss": ce.astype(jnp.float32),
+                 "moe_aux_loss": aux.aux_loss,
+                 "moe_z_loss": aux.z_loss,
+                 "moe_drop_fraction": aux.drop_fraction,
+                 "moe_gate_entropy": aux.gate_entropy}
+        return total, stats
+
+    def loss(self, params, tokens, labels, key=None):
+        return self.loss_with_stats(params, tokens, labels, key)[0]
+
+
+# preset ≡ the GPT-350M bench point with 8 experts (params grow ~4x,
+# per-token FLOPs stay ~dense + router)
+MOE_GPT_350M_8E = dict(hidden=1024, num_layers=24, num_heads=16,
+                       n_experts=8, top_k=2)
+
+
+def moe_smoke_config(ep: int = 1, **overrides) -> MoEGPTConfig:
+    """The CPU smoke shape every gate/test builds (mirrors the dense
+    smoke configs of bench/lint/comms): tiny GPT dims, 4 experts."""
+    cfg = dict(vocab_size=512, seq_len=64, hidden=64, num_layers=2,
+               num_heads=4, dropout=0.0, n_experts=4, top_k=2,
+               capacity_factor=2.0, expert_parallel=ep)
+    cfg.update(overrides)
+    return MoEGPTConfig(**cfg)
+
+
+def build_moe_train_step(on_tpu: bool = False, *, batch=None,
+                         n_buckets: int = 2, metrics=None, trace=None,
+                         devices=None):
+    """The flagship MoE-GPT training step — ONE builder shared by
+    bench.py, `lint_step.py moe`, `comms_probe.py moe`, and the tests
+    (the no-drift rule of the other flagship builders).
+
+    Meshes over ALL visible devices: ep = 2 whenever the device count
+    is even (the dp=2 x ep=2 acceptance grid on a 4-device mesh; dp=4
+    x ep=2 on the 8-way test mesh), else ep = 1.  The batch is rounded
+    up to a dp*ep multiple.  ZeRO-2 `DistributedFusedAdam` shards the
+    master state over the combined data axes.
+
+    Returns (model, step, args, info): `args` is
+    (opt_state, None, (tokens_sds, labels_sds)) — real sharded state,
+    ShapeDtypeStruct batch (lint traces / comms AOT-compiles it;
+    callers that EXECUTE substitute real int32 arrays of the same
+    shape, see info["batch"]/info["seq"]).
+    """
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from apex_tpu.optimizers.distributed_fused_adam import (
+        DistributedFusedAdam,
+    )
+    from apex_tpu.parallel import ddp
+    from apex_tpu.parallel import mesh as M
+
+    if devices is None:
+        devices = jax.devices()
+    n_dev = len(devices)
+    ep = 2 if n_dev % 2 == 0 else 1
+    M.destroy_model_parallel()
+    mesh = M.initialize_model_parallel(expert_model_parallel_size=ep,
+                                       devices=devices)
+    dp = M.get_data_parallel_world_size()
+    data_axes = M.get_data_parallel_axis_names()
+    axis_name = data_axes if len(data_axes) > 1 else data_axes[0]
+
+    if on_tpu:
+        batch = batch or 8
+        seq = 1024
+        cfg = MoEGPTConfig(vocab_size=50304, seq_len=seq, dropout=0.0,
+                           dtype=jnp.bfloat16, logits_dtype=jnp.bfloat16,
+                           use_flash_attention=True, expert_parallel=ep,
+                           capacity_factor=1.25,
+                           **{k: v for k, v in MOE_GPT_350M_8E.items()
+                              if k != "num_layers"}, num_layers=12)
+    else:
+        batch = batch or 4
+        seq = 64
+        cfg = moe_smoke_config(ep=ep)
+    world = dp * ep
+    batch = -(-batch // world) * world
+
+    model = MoEGPT(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+    opt = DistributedFusedAdam(
+        num_shards=world, lr=1e-4, n_buckets=n_buckets,
+        axis_name=axis_name, ep_shards=ep,
+        use_pallas=on_tpu or None,
+        master_dtype=jnp.bfloat16 if on_tpu else jnp.float32)
+    sspec = opt.state_partition_specs()
+    state = jax.jit(shard_map(opt.init, mesh=mesh, in_specs=(P(),),
+                              out_specs=sspec, check_vma=False))(params)
+
+    def loss_fn(p, b):
+        return model.loss_with_stats(p, b[0], b[1])
+
+    step = ddp.make_train_step(
+        loss_fn, opt, mesh, axis_name=axis_name,
+        batch_spec=(P(axis_name), P(axis_name)), has_aux=True,
+        metrics=metrics, trace=trace)
+    del params
+    tokens = jax.ShapeDtypeStruct((batch, seq), jnp.int32)
+    labels = jax.ShapeDtypeStruct((batch, seq), jnp.int32)
+    info = {"batch": batch, "seq": seq, "dp": dp, "ep": ep,
+            "vocab_size": cfg.vocab_size, "config": cfg, "mesh": mesh}
+    return model, step, (state, None, (tokens, labels)), info

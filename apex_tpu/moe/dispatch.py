@@ -1,0 +1,97 @@
+"""Dense dispatch/combine + the ep all-to-all exchange.
+
+The sparse-accumulation lesson of arXiv 1905.04035 applied to expert
+parallelism: **densify before the collective, never ship ragged sparse
+payloads**.  Tokens scatter into a fixed `(n_experts, capacity,
+d_model)` buffer (dropped tokens go to a trash row that stays local,
+so the exchanged payload's shape depends on NOTHING the router
+decided), and the whole cross-expert exchange is ONE tiled
+`all_to_all` over the `ep` mesh axis each way:
+
+    dispatch:  (E, C, H) --all_to_all(split 0, concat 1)--> (E/ep, ep*C, H)
+    combine:   (E/ep, ep*C, H) --all_to_all(split 1, concat 0)--> (E, C, H)
+
+Each shard dispatches its LOCAL tokens into slots for ALL E global
+experts; the exchange hands every ep peer the block for the experts it
+owns and returns the computed outputs the same way.  The payload is
+E*C*H * itemsize bytes per direction, priced by the ICI roofline's
+ring all-to-all formula ((n-1)/n * D / bw, monitor/comms/roofline.py)
+and inventoried by the comms gate (`comms_probe.py moe`).
+
+Scatter/gather discipline: every non-trash destination row is unique
+by construction (positions within an expert are distinct across all
+(token, slot) assignments), so the scatter is exact — a kept token's
+row is its activation bit-for-bit, which is what makes the
+capacity_factor=inf round trip and the n_experts=1 dense-GPT parity
+BITWISE, not just close.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+
+def dispatch(x, dest, n_experts: int, capacity: int) -> jnp.ndarray:
+    """Scatter token rows x (T, H) to their destination slots.
+
+    dest: (T, k) flat rows from `router.capacity_destinations`.
+    Returns the dense (E*C + 1, H) buffer in x's dtype — row E*C is
+    the trash row (dropped tokens pile up there and are never read).
+    Non-trash rows are unique, so `.set` writes each kept token's
+    activation exactly; unfilled slots stay zero and contribute
+    nothing downstream (zero rows through the expert MLP produce
+    bias-only outputs that combine never reads)."""
+    t, h = x.shape
+    k = dest.shape[1]
+    buf = jnp.zeros((n_experts * capacity + 1, h), x.dtype)
+    for j in range(k):
+        buf = buf.at[dest[:, j]].set(x)
+    return buf
+
+
+def combine(ybuf, dest, gate) -> jnp.ndarray:
+    """Gather expert outputs back to token order, weighted by gates.
+
+    ybuf: (E*C + 1, H) with the trash row ZEROED (exchange_combine
+    rebuilds it that way), dest: (T, k), gate: (T, k) fp32 raw gate
+    probs.  Dropped assignments index the trash row and contribute
+    exactly 0 — a fully dropped token passes through on the residual
+    alone.  The weight multiply casts the GATE to the activation
+    dtype (not the activations to fp32): at gate == 1.0 the product
+    is the expert output bit-for-bit, the dense-parity anchor."""
+    k = dest.shape[1]
+    out = ybuf[dest[:, 0]] * gate[:, 0, None].astype(ybuf.dtype)
+    for j in range(1, k):
+        out = out + ybuf[dest[:, j]] * gate[:, j, None].astype(ybuf.dtype)
+    return out
+
+
+def exchange_dispatch(buf, ep_axis, ep_size: int, n_experts: int,
+                      capacity: int) -> jnp.ndarray:
+    """(E*C+1, H) local dispatch buffer -> (E/ep, ep*C, H) rows for
+    THIS shard's experts, gathered from every ep peer.  The trash row
+    is sliced off first — it is local-only garbage and shipping it
+    would waste ICI bytes for values nobody reads.  ep_size == 1 is
+    the degenerate reshape (no collective traced at all)."""
+    h = buf.shape[1]
+    ebuf = buf[:n_experts * capacity].reshape(n_experts, capacity, h)
+    if ep_size == 1:
+        return ebuf
+    # tiled all_to_all: expert-group chunk g of dim 0 ships to ep peer
+    # g; the ep received chunks concatenate along the slot dim
+    return lax.all_to_all(ebuf, ep_axis, split_axis=0, concat_axis=1,
+                          tiled=True)
+
+
+def exchange_combine(y, ep_axis, ep_size: int, n_experts: int,
+                     capacity: int) -> jnp.ndarray:
+    """Inverse exchange + trash-row rebuild: expert outputs
+    (E_loc, ep*C, H) -> the (E*C + 1, H) combine buffer in original
+    (expert, slot) order with a fresh zero trash row."""
+    h = y.shape[-1]
+    if ep_size > 1:
+        y = lax.all_to_all(y, ep_axis, split_axis=1, concat_axis=0,
+                           tiled=True)
+    flat = y.reshape(n_experts * capacity, h)
+    return jnp.concatenate([flat, jnp.zeros((1, h), flat.dtype)], axis=0)

@@ -1,0 +1,276 @@
+"""`MoEMLP` — the expert-parallel drop-in for a transformer block's MLP.
+
+Composition of the subsystem's three pieces (router.py, dispatch.py)
+into one shard-local layer that runs inside `shard_map` over the
+(pp, dp[, ep], tp) mesh:
+
+    route (fp32 gates) -> dispatch into (E, C, H) -> all_to_all over ep
+    -> per-expert FFN (bf16-friendly, fp32 MXU accumulation)
+    -> all_to_all back -> combine weighted by raw gate probs
+
+Parameter layout: every shard holds the FULL (E, ...) expert tensors —
+the ZeRO-2 posture: compute-time replicated, master state sharded over
+the combined (dp, ep) axes by `DistributedFusedAdam(num_shards=dp*ep,
+axis_name=("dp","ep"), ep_shards=ep)` — and slices its own E/ep
+experts by `lax.axis_index("ep")` at compute time.  Gradient
+correctness needs NO expert-special sync: the combine all_to_all's AD
+transpose routes each shard's loss cotangents back to the shard that
+computed the expert, so after backward every shard already holds
+d(sum of its ep group's losses)/d(its expert slice) — a uniform pmean
+over ("dp", "ep") is then exact for expert and non-expert params
+alike (docs/moe.md derives this).
+
+Telemetry: when a flight-recorder TapContext is armed, the layer taps
+`{prefix}/load` (per-expert assignment fractions — absmax = hottest
+expert), `{prefix}/drop` (per-expert dropped fractions — mean = drop
+fraction) and `{prefix}/gate_entropy` (per-token gate entropy — mean
+falling toward 0 = router collapse) through the existing TapState
+plane: zero host syncs, zero collectives, and the untapped program is
+byte-identical because the whole hook is trace-time gated on
+`active_tap_context()`.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.ad_checkpoint import checkpoint_name
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu.moe import dispatch as D
+from apex_tpu.moe import router as R
+from apex_tpu.ops._common import active_tap_context, tap as _tap
+from apex_tpu.parallel.collectives import (
+    copy_to_tensor_model_parallel_region,
+    reduce_from_tensor_model_parallel_region,
+)
+from apex_tpu.parallel.mesh import EP_AXIS
+
+
+class MoEAux(NamedTuple):
+    """Per-layer fp32 scalars the model folds into its loss/stats."""
+
+    aux_loss: jnp.ndarray        # load-balancing loss (1.0 = balanced)
+    z_loss: jnp.ndarray          # router z-loss
+    drop_fraction: jnp.ndarray   # dropped assignments / (T * k)
+    gate_entropy: jnp.ndarray    # mean per-token gate entropy
+
+
+class MoEMLP:
+    """Expert MLP bank: E experts of (H -> ffn_mult*H -> H), gelu.
+
+    Drop-in for the GPT block's ColumnParallel->gelu->RowParallel MLP:
+    at n_experts=1 / top_k=1 / capacity_factor=inf the output is
+    BITWISE the dense MLP's (same GEMM contractions row-for-row, gate
+    exactly 1.0) — the acceptance anchor tests/test_moe.py pins.
+    """
+
+    def __init__(self, hidden: int, ffn_hidden: int, n_experts: int, *,
+                 top_k: int = 1, capacity_factor: float = 1.25,
+                 ep_size: int = 1, ep_axis: str = EP_AXIS,
+                 init_std: float = 0.02,
+                 proj_init_std: Optional[float] = None,
+                 router_block_rows: Optional[int] = None,
+                 tp_axis: Optional[str] = None):
+        if n_experts % max(1, ep_size):
+            raise ValueError(
+                f"n_experts={n_experts} must divide by ep_size={ep_size}")
+        if top_k > n_experts:
+            raise ValueError(f"top_k={top_k} > n_experts={n_experts}")
+        self.hidden = hidden
+        self.ffn_hidden = ffn_hidden
+        self.n_experts = n_experts
+        self.top_k = top_k
+        self.capacity_factor = float(capacity_factor)
+        self.ep_size = ep_size
+        self.ep_axis = ep_axis
+        self.init_std = init_std
+        self.proj_init_std = proj_init_std or init_std
+        self.router_block_rows = router_block_rows
+        # tp_axis: the dense GPT block's tensor-parallel region markers
+        # (ColumnParallel's copy_to on entry, RowParallel's reduce_from
+        # before the output bias), mirrored here so the drop-in keeps
+        # the identical op sequence.  MoE experts REPLICATE over tp —
+        # only tp == 1 is supported (the markers are then identities;
+        # at tp > 1 the duplicate-compute reduce would scale outputs
+        # by tp, so apply() raises at trace time when the bound tp
+        # axis has size > 1).
+        self.tp_axis = tp_axis
+
+    # ------------------------------ params --------------------------------
+
+    def init(self, key, dtype=jnp.float32) -> dict:
+        kg, k1, k2 = jax.random.split(key, 3)
+        e, h, f = self.n_experts, self.hidden, self.ffn_hidden
+        return {
+            "wg": jax.random.normal(kg, (h, e), dtype) * self.init_std,
+            "w1": jax.random.normal(k1, (e, h, f), dtype) * self.init_std,
+            "b1": jnp.zeros((e, f), dtype),
+            "w2": jax.random.normal(k2, (e, f, h), dtype)
+            * self.proj_init_std,
+            "b2": jnp.zeros((e, h), dtype),
+        }
+
+    def partition_specs(self) -> dict:
+        """Everything REPLICATED — the compute-time contract: every
+        shard holds the full (E, ...) expert tensors (the ZeRO-2
+        posture; `_local_experts` slices this shard's E/ep experts by
+        axis_index at compute time, which requires the full tensor as
+        input).  Ep-RESIDENT expert params — P(ep, ...) leaves with no
+        gather — are the ZeRO-3 rung of ROADMAP item 1, and would
+        change `_local_experts` in the same commit as this spec."""
+        return {"wg": P(), "w1": P(), "b1": P(), "w2": P(), "b2": P()}
+
+    # ------------------------------ forward -------------------------------
+
+    def _local_experts(self, params):
+        """This shard's E/ep slice of each expert tensor (the whole
+        tensor when ep_size == 1 — no axis_index traced)."""
+        if self.ep_size == 1:
+            return (params["w1"], params["b1"], params["w2"], params["b2"])
+        e_loc = self.n_experts // self.ep_size
+        start = lax.axis_index(self.ep_axis) * e_loc
+
+        def sl(a):
+            return lax.dynamic_slice_in_dim(a, start, e_loc, axis=0)
+
+        return (sl(params["w1"]), sl(params["b1"]),
+                sl(params["w2"]), sl(params["b2"]))
+
+    def _expert_ffn(self, params, xe, cn=None):
+        """Per-expert FFN on the exchanged buffer (E_loc, rows, H):
+        the same dot/astype/bias/gelu sequence as the dense
+        ColumnParallel -> gelu -> RowParallel pair, batched over the
+        expert dim with fp32 MXU accumulation.  cn: optional pair of
+        checkpoint_name tags applied where the dense GPT block tags
+        its MLP (after each projection+bias) — the model passes
+        ("ffn1", "ffn_out") so remat policies keep addressing the
+        same points."""
+        w1, b1, w2, b2 = self._local_experts(params)
+        h = jnp.einsum("ech,ehf->ecf", xe, w1,
+                       preferred_element_type=jnp.float32).astype(xe.dtype)
+        h = h + b1[:, None, :].astype(h.dtype)
+        if cn:
+            h = checkpoint_name(h, cn[0])
+        h = jax.nn.gelu(h, approximate=True)
+        y = jnp.einsum("ecf,efh->ech", h, w2,
+                       preferred_element_type=jnp.float32).astype(h.dtype)
+        if self.tp_axis is not None:
+            y = reduce_from_tensor_model_parallel_region(y, self.tp_axis)
+        y = y + b2[:, None, :].astype(y.dtype)
+        if cn:
+            y = checkpoint_name(y, cn[1])
+        return y
+
+    def apply(self, params, x, tap_prefix: Optional[str] = None,
+              cn=None):
+        """x: (..., H) shard-local activations (any leading dims —
+        (S, B, H) from a GPT block).  Returns (y, MoEAux) with y in
+        x's shape and dtype.  Call inside shard_map when ep_size > 1
+        (the all_to_all needs the bound ep axis).  cn: checkpoint_name
+        tag pair, see _expert_ffn."""
+        lead_shape = x.shape[:-1]
+        if self.tp_axis is not None:
+            try:
+                tp = int(lax.axis_size(self.tp_axis))
+            except NameError:  # axis unbound (outside shard_map)
+                tp = 1
+            if tp > 1:
+                # loud error, not silent wrongness: experts REPLICATE
+                # over tp, so the duplicate-compute reduce_from below
+                # would scale every MoE output by tp
+                raise NotImplementedError(
+                    f"MoEMLP does not support tensor parallelism yet "
+                    f"(tp axis {self.tp_axis!r} has size {tp}): experts "
+                    "replicate over tp and the RowParallel-style "
+                    "reduction would multiply outputs by tp — build "
+                    "the MoE mesh with tensor_model_parallel_size=1")
+            # the dense ColumnParallel entry marker (identity forward,
+            # grad psum over tp in backward) — see __init__
+            x = copy_to_tensor_model_parallel_region(x, self.tp_axis)
+        xt = x.reshape(-1, self.hidden)
+        t = xt.shape[0]
+        e, k = self.n_experts, self.top_k
+        cap = R.expert_capacity(t, e, k, self.capacity_factor)
+
+        out = R.topk_gates(xt, params["wg"], k,
+                           block_rows=self.router_block_rows)
+        if e == 1 and k == 1 and cap >= t and self.ep_size == 1:
+            # Degenerate routing (the n_experts=1 limit): every token
+            # goes to expert 0 with gate exactly 1.0 and the dispatch
+            # permutation is the identity, so the scatter/exchange/
+            # gather collapses away and the expert FFN runs on the
+            # ORIGINAL activation shape — a real optimization (no
+            # buffers, no scatter) that also makes this limit BITWISE
+            # the dense MLP: same op shapes means XLA fuses the bias-
+            # grad reductions identically (the general (E, C, H) path
+            # is bitwise in VALUES but fuses those reduces in a
+            # different loop order).  Dispatch itself is covered by
+            # the round-trip and dp x ep grid tests.
+            dropped = jnp.zeros((1,), jnp.float32)
+            y1 = jnp.dot(x, params["w1"][0],
+                         preferred_element_type=jnp.float32
+                         ).astype(x.dtype)
+            y1 = y1 + params["b1"][0].astype(y1.dtype)
+            if cn:
+                y1 = checkpoint_name(y1, cn[0])
+            y1 = jax.nn.gelu(y1, approximate=True)
+            y2 = jnp.dot(y1, params["w2"][0],
+                         preferred_element_type=jnp.float32
+                         ).astype(y1.dtype)
+            if self.tp_axis is not None:
+                y2 = reduce_from_tensor_model_parallel_region(
+                    y2, self.tp_axis)
+            # softmax over ONE logit is identically the constant 1.0,
+            # so the gate weighting is the identity FUNCTION (value
+            # and derivative) — skipping the multiply is exact, and
+            # keeps the router's ops out of the MLP's forward/backward
+            # fusion neighborhoods (an extra *1.0 changes nothing in
+            # values but re-tiles the layernorm-backward reduce, an
+            # accumulation-order wobble that would break the bitwise
+            # anchor).  The router still runs for gates/aux stats.
+            y2 = y2 + params["b2"][0].astype(y2.dtype)
+            if cn:
+                y2 = checkpoint_name(y2, cn[1])
+            y = y2.reshape(-1, self.hidden)
+        else:
+            dest, dropped = R.capacity_destinations(out.idx, e, cap)
+            buf = D.dispatch(xt, dest, e, cap)
+            xe = D.exchange_dispatch(buf, self.ep_axis, self.ep_size, e,
+                                     cap)
+            ye = self._expert_ffn(params, xe, cn=cn)
+            ybuf = D.exchange_combine(ye, self.ep_axis, self.ep_size, e,
+                                      cap)
+            y = D.combine(ybuf, dest, out.gate)
+
+        aux_loss, load, _ = R.load_balancing_aux(out.probs, out.idx, e)
+        drop_per_expert = dropped / jnp.asarray(t * k, jnp.float32)
+        ent = R.gate_entropy(out.probs)
+        aux = MoEAux(aux_loss=aux_loss,
+                     z_loss=R.router_z_loss(out.logits),
+                     drop_fraction=jnp.sum(drop_per_expert),
+                     gate_entropy=jnp.mean(ent))
+
+        if tap_prefix is not None and active_tap_context() is not None:
+            # flight-recorder hook, armed at TRACE time only: the
+            # tapped stat tensors ride into the loss through a 0.0 *
+            # sum so AD's probe-cotangent path runs for them (the fwd
+            # stats plane is a residual — a zero cotangent still emits
+            # it); untapped traces skip this block entirely, keeping
+            # the byte-identical contract of ops._common.tap
+            s = (_tap(load, f"{tap_prefix}/load").sum()
+                 + _tap(drop_per_expert, f"{tap_prefix}/drop").sum()
+                 + _tap(ent, f"{tap_prefix}/gate_entropy").sum())
+            y = y + (0.0 * s).astype(y.dtype)
+
+        return y.reshape(*lead_shape, self.hidden), aux
+
+
+def mean_aux(auxes) -> MoEAux:
+    """Average a list of per-layer MoEAux into one (fp32 scalars)."""
+    n = jnp.asarray(len(auxes), jnp.float32)
+    return MoEAux(*[
+        sum(getattr(a, f) for a in auxes) / n for f in MoEAux._fields])

@@ -77,6 +77,14 @@ from apex_tpu.monitor.sinks import MetricSink, ScalarWriter
 # is NOT stamped: a vacuous green would paint an outage window).  All
 # OPTIONAL, never-null when present (the v4 rule: no samples → no
 # field, never a null), same reserved `serve_` scalar prefix as v5.
+# v9 (ISSUE 13): the Mixture-of-Experts fields — `moe_aux_loss`
+# (load-balancing loss, 1.0 = perfectly balanced), `moe_drop_fraction`
+# (capacity-dropped assignment fraction), `moe_gate_entropy` (mean
+# per-token gate entropy — falling toward 0 = router collapse),
+# `moe_z_loss`, and bench's `moe_tokens_per_sec` — all OPTIONAL,
+# never-null when present (a logger without an attached MoERecorder,
+# or one attached before the first step, simply doesn't stamp them);
+# `moe_` joins the reserved scalar prefixes.
 # v8 (ISSUE 11): the fleet fault-tolerance fields —
 # `ckpt_commit_barrier_s` (how long process 0's multi-host commit
 # barrier waited on the slowest host's sub-manifest; stamped only by a
@@ -87,7 +95,7 @@ from apex_tpu.monitor.sinks import MetricSink, ScalarWriter
 # `fleet_resume_ok` (bench's kill→resume cycle verdict).  All
 # OPTIONAL, never-null when present; `fleet_` joins the reserved
 # scalar prefixes.
-SCHEMA_VERSION = 8
+SCHEMA_VERSION = 9
 
 # field -> (python type, finite_required).  loss_scale may legitimately
 # be large but is finite; grad/update norms are inf/nan ON overflow
@@ -172,9 +180,17 @@ OPTIONAL_SCHEMA = {
     "fleet_resumes": (int, False),
     "fleet_dp": (int, False),
     "fleet_resume_ok": (bool, False),
+    # v9 (ISSUE 13): the MoE plane.  Aux scalars appear once an
+    # MoERecorder is attached (moe=) and fed a step's aux;
+    # moe_tokens_per_sec is bench's stamp — never null.
+    "moe_tokens_per_sec": (float, False),
+    "moe_aux_loss": (float, False),
+    "moe_z_loss": (float, False),
+    "moe_drop_fraction": (float, False),
+    "moe_gate_entropy": (float, False),
 }
 _OPTIONAL_PREFIXES = ("compile_", "hbm_", "comms_", "serve_", "ckpt_",
-                      "fleet_")
+                      "fleet_", "moe_")
 
 
 def validate_record(record: dict, prev_step: Optional[int] = None) -> None:
@@ -267,7 +283,8 @@ class MetricsLogger:
                  memory_device=None,
                  ckpt=None,
                  serve=None,
-                 fleet=None):
+                 fleet=None,
+                 moe=None):
         self.sinks = list(sinks)
         self.flops_per_step = flops_per_step
         # None resolves the per-chip peak from the device kind (ISSUE 5
@@ -306,6 +323,13 @@ class MetricsLogger:
         # shrink is visible in the same stream as the step-times it
         # changed.
         self.fleet = fleet
+        # moe: a moe.MoERecorder (anything with .moe_record()) — every
+        # record gains the v9 `moe_*` aux scalars of the newest step
+        # the trainer fed it (ISSUE 13), so router collapse and
+        # capacity dropping are visible in the same stream as the
+        # loss they degrade.  Host-side only: the trainer updates the
+        # recorder with the aux pytree the step already returns.
+        self.moe = moe
         # taps=True: log_step(…, taps=tap_state) folds the flight
         # recorder's per-layer stat planes into each record as compact
         # summary fields (tap_fwd_absmax / tap_grad_absmax /
@@ -409,6 +433,8 @@ class MetricsLogger:
             record.update(self.serve.serve_record())
         if self.fleet is not None:
             record.update(self.fleet.stats())
+        if self.moe is not None:
+            record.update(self.moe.moe_record())
         if extra:
             record.update(extra)
         for s in self.sinks:

@@ -65,6 +65,21 @@ class _ShardedFlat(F.FlatCheckpointMixin):
     restore-before-init guard) comes from FlatCheckpointMixin."""
 
     _ALIGN = 1  # subclasses override when they need lane-aligned leaves
+    # expert-parallel annotation (apex_tpu.moe): when the flat state
+    # shards over the COMBINED ("dp", "ep") axes, ep_shards records the
+    # ep factor so the checkpoint layout names the expert sharding and
+    # `restore_sharded` can refuse an ep re-shard BY NAME instead of
+    # silently concatenating (ISSUE 13 satellite).  1 = dense layout.
+    ep_shards = 1
+
+    def _set_ep_shards(self, num_shards: int, ep_shards: int) -> None:
+        """The ONE validation both ZeRO constructors run — the invariant
+        (and its message, which tests match on) lives here."""
+        if ep_shards < 1 or num_shards % ep_shards:
+            raise ValueError(
+                f"ep_shards={ep_shards} must be >= 1 and divide "
+                f"num_shards={num_shards} (num_shards = dp * ep)")
+        self.ep_shards = ep_shards
 
     def _make_spec(self, params):
         self.spec = F.make_spec(params, align=self._ALIGN)
@@ -129,14 +144,22 @@ class _ShardedFlat(F.FlatCheckpointMixin):
             raise RuntimeError(
                 f"{type(self).__name__}.shard_layout() before init(); "
                 "call init(params) first so the flat layout is fixed")
-        return {"align": int(self.spec.align),
-                "total": int(self.spec.total),
-                "n_tensors": len(self.spec.sizes),
-                "num_shards": int(self.num_shards),
-                "n_buckets": 1,
-                "bucket_totals": [int(self.spec.total)],
-                "bucket_padded": [int(self.padded_total)],
-                "master_dtype": str(jnp.dtype(self.master_dtype))}
+        d = {"align": int(self.spec.align),
+             "total": int(self.spec.total),
+             "n_tensors": len(self.spec.sizes),
+             "num_shards": int(self.num_shards),
+             "n_buckets": 1,
+             "bucket_totals": [int(self.spec.total)],
+             "bucket_padded": [int(self.padded_total)],
+             "master_dtype": str(jnp.dtype(self.master_dtype))}
+        if int(getattr(self, "ep_shards", 1)) > 1:
+            # expert-sharded layout: num_shards = dp * ep with the ep
+            # factor named, so a restore at a different ep topology is
+            # refused by name (checkpoint/sharded._check_layouts)
+            # rather than silently re-laid; dense manifests omit the
+            # key (old checkpoints keep restoring unchanged)
+            d["ep_shards"] = int(self.ep_shards)
+        return d
 
 
 class DistributedFusedAdam(_ShardedFlat):
@@ -147,11 +170,11 @@ class DistributedFusedAdam(_ShardedFlat):
 
     def __init__(self, num_shards: int, lr=1e-3, bias_correction=True,
                  betas=(0.9, 0.999), eps=1e-8, adam_w_mode=True,
-                 weight_decay=0.0, axis_name: str = DP_AXIS,
+                 weight_decay=0.0, axis_name=DP_AXIS,
                  grad_sync_dtype=jnp.float32, param_sync_dtype=None,
                  n_buckets: int = 1, master_dtype=jnp.float32,
                  use_pallas: Optional[bool] = None,
-                 wd_mask=None, lr_scales=None):
+                 wd_mask=None, lr_scales=None, ep_shards: int = 1):
         """master_dtype=bf16 shards bf16 p/m/v state (in-kernel math
         stays fp32) — the ZeRO counterpart of FusedAdam's bf16-state
         dial; halves per-rank state memory AND the update-pass HBM
@@ -171,7 +194,13 @@ class DistributedFusedAdam(_ShardedFlat):
         as init's params) ≡ the reference's param_groups — see
         FusedAdam; applied per bucket shard with the shard's global row
         offset, so every rank updates its fragment with the right
-        per-tensor hyperparameters."""
+        per-tensor hyperparameters.
+
+        axis_name may be a TUPLE of mesh axes (the MoE wiring shards
+        over the combined ("dp", "ep") axes with num_shards = dp*ep —
+        every collective here takes the tuple natively); ep_shards
+        then records the ep factor for the checkpoint layout, see
+        _ShardedFlat.ep_shards."""
         self.num_shards = num_shards
         self.lr = lr
         self.bias_correction = bias_correction
@@ -187,6 +216,7 @@ class DistributedFusedAdam(_ShardedFlat):
         self.use_pallas = use_pallas
         self.wd_mask = wd_mask
         self.lr_scales = lr_scales
+        self._set_ep_shards(num_shards, ep_shards)
         self._seg_wd = None
         self._seg_lrs = None
         if wd_mask is not None or lr_scales is not None:
@@ -431,12 +461,15 @@ class DistributedFusedLAMB(_ShardedFlat):
 
     def __init__(self, num_shards: int, lr=1e-3, bias_correction=True,
                  betas=(0.9, 0.999), eps=1e-6, weight_decay=0.01,
-                 max_grad_norm=1.0, axis_name: str = DP_AXIS,
+                 max_grad_norm=1.0, axis_name=DP_AXIS,
                  grad_sync_dtype=jnp.float32, param_sync_dtype=None,
                  master_dtype=jnp.float32,
                  use_pallas: Optional[bool] = None,
-                 wd_mask=None, lr_scales=None):
+                 wd_mask=None, lr_scales=None, ep_shards: int = 1):
         self.num_shards = num_shards
+        # expert-sharded (dp, ep) layouts record their ep factor in the
+        # checkpoint manifest — see DistributedFusedAdam
+        self._set_ep_shards(num_shards, ep_shards)
         self.lr = lr
         self.bias_correction = bias_correction
         self.beta1, self.beta2 = betas

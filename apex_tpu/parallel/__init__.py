@@ -7,10 +7,13 @@ apex.transformer.parallel_state, re-based on `jax.sharding.Mesh`.
 from apex_tpu.parallel import collectives, mesh
 from apex_tpu.parallel.mesh import (
     DP_AXIS,
+    EP_AXIS,
     PP_AXIS,
     TP_AXIS,
     destroy_model_parallel,
+    get_data_parallel_axis_names,
     get_data_parallel_world_size,
+    get_expert_model_parallel_world_size,
     get_mesh,
     get_pipeline_model_parallel_world_size,
     get_rank_info,
@@ -23,9 +26,11 @@ from apex_tpu.parallel.mesh import (
 __all__ = [
     "mesh", "collectives", "initialize_model_parallel",
     "destroy_model_parallel", "model_parallel_is_initialized", "get_mesh",
-    "named_sharding", "DP_AXIS", "PP_AXIS", "TP_AXIS", "get_rank_info",
+    "named_sharding", "DP_AXIS", "PP_AXIS", "TP_AXIS", "EP_AXIS",
+    "get_rank_info",
     "get_data_parallel_world_size", "get_tensor_model_parallel_world_size",
     "get_pipeline_model_parallel_world_size",
+    "get_expert_model_parallel_world_size", "get_data_parallel_axis_names",
 ]
 
 

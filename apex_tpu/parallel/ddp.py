@@ -33,8 +33,27 @@ from apex_tpu.optimizers import flat as F
 from apex_tpu.parallel.mesh import DP_AXIS
 
 
-def sync_gradients(grads, axis_name: str = DP_AXIS, average: bool = True):
-    """All-reduce a grad pytree over the data-parallel axis.
+def _axis_size(axis_name) -> int:
+    """Static size of one axis or of a tuple of axes (their product).
+
+    `lax.axis_size` takes a single name; MoE steps sync over the
+    combined ("dp", "ep") data axes (mesh.get_data_parallel_axis_names)
+    and need the product — the collective primitives themselves take
+    the tuple directly."""
+    if isinstance(axis_name, (tuple, list)):
+        n = 1
+        for a in axis_name:
+            n *= int(jax.lax.axis_size(a))
+        return n
+    return int(jax.lax.axis_size(axis_name))
+
+
+def sync_gradients(grads, axis_name=DP_AXIS, average: bool = True):
+    """All-reduce a grad pytree over the data-parallel axis (or axis
+    TUPLE — an expert-parallel step averages over ("dp", "ep"): the
+    MoE all-to-all's AD transpose already summed each expert's partial
+    grads across ep, so one uniform pmean over the combined axes is
+    exact for expert and non-expert params alike, docs/moe.md).
 
     ≡ DDP's bucketed allreduce with gradient_average=True
     (apex/parallel/distributed.py:449-458).  Inside pjit/shard_map only.
@@ -149,6 +168,13 @@ def make_train_step(loss_fn: Callable, optimizer, mesh, *,
     reference transformer/tensor_parallel/layers.py:415-428).  The fp32
     grads flow to the grad pmean and the fused optimizer as-is (the
     flat kernels take any float grad dtype).
+
+    axis_name may be a TUPLE of mesh axes — an expert-parallel MoE
+    step syncs over ("dp", "ep") (mesh.get_data_parallel_axis_names):
+    the batch shards over the combined axes, grads pmean over both,
+    and a ZeRO optimizer built with num_shards = dp*ep and the same
+    tuple shards its flat state over the product axis.  Every
+    collective primitive involved takes the tuple natively.
 
     ZERO-2: `optimizer` may be a sharded optimizer
     (`DistributedFusedAdam` / `DistributedFusedLAMB` — detected via
@@ -413,7 +439,7 @@ def make_train_step(loss_fn: Callable, optimizer, mesh, *,
                 tokens = metrics_cfg.tokens_per_step
             else:
                 tokens = (_mon.infer_tokens_per_step(raw_batch)
-                          * jax.lax.axis_size(axis_name))
+                          * _axis_size(axis_name))
             # flat optimizers carry the master buffer as state.params;
             # norms read it directly (no per-leaf tree walk).  ZeRO
             # states carry rank SHARDS (params_shard): global norms are

@@ -26,9 +26,14 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 # Canonical axis names.  (dp, pp, tp) mirrors the reference's
 # data-/pipeline-/tensor-parallel groups; "sp" is not a separate axis —
 # Megatron sequence parallelism shards the sequence dim over the tp axis.
+# "ep" is the expert-parallel axis (apex_tpu.moe): present in the mesh
+# ONLY when initialize_model_parallel is asked for
+# expert_model_parallel_size > 1, so dense programs trace over the
+# identical 3-axis mesh they always did.
 DP_AXIS = "dp"
 PP_AXIS = "pp"
 TP_AXIS = "tp"
+EP_AXIS = "ep"
 
 _GLOBAL_STATE = None
 
@@ -39,6 +44,7 @@ class _MeshState:
     tensor_model_parallel_size: int
     pipeline_model_parallel_size: int
     data_parallel_size: int
+    expert_model_parallel_size: int = 1
     virtual_pipeline_model_parallel_size: Optional[int] = None
     # Mutable "current rank" cursors used by host-driven pipeline code,
     # mirroring the reference's get/set_virtual_pipeline_model_parallel_rank
@@ -57,38 +63,57 @@ def initialize_model_parallel(
     pipeline_model_parallel_size: int = 1,
     virtual_pipeline_model_parallel_size: Optional[int] = None,
     pipeline_model_parallel_split_rank: Optional[int] = None,
+    expert_model_parallel_size: int = 1,
     use_fp8: bool = False,
     devices: Optional[Sequence[jax.Device]] = None,
 ) -> Mesh:
-    """Build the global (pp, dp, tp) mesh.
+    """Build the global (pp, dp[, ep], tp) mesh.
 
     ≡ parallel_state.initialize_model_parallel (parallel_state.py:155-419),
     with process groups replaced by named mesh axes.  The data-parallel
-    size is inferred as n_devices // (tp * pp), exactly like the
+    size is inferred as n_devices // (tp * pp * ep), exactly like the
     reference's `data_parallel_size = world_size // (tp*pp)`
     (parallel_state.py:242-244).
+
+    expert_model_parallel_size > 1 inserts the expert-parallel axis
+    between dp and tp — inner to dp so the MoE dispatch/combine
+    all-to-alls (apex_tpu.moe) ride faster ICI links than the dp grad
+    sync, outer to tp so each expert's GEMMs can still shard over tp.
+    With the default (1) the mesh is the exact 3-axis (pp, dp, tp)
+    layout every dense program has always traced over — no ep axis
+    appears, so compiled programs, comms fixtures, and lint traces of
+    dense steps are byte-identical to the pre-MoE framework.
     """
     global _GLOBAL_STATE
     if devices is None:
         devices = jax.devices()
     world_size = len(devices)
     tp, pp = tensor_model_parallel_size, pipeline_model_parallel_size
-    if world_size % (tp * pp) != 0:
+    ep = expert_model_parallel_size
+    if ep < 1:
+        raise ValueError(f"expert_model_parallel_size must be >= 1, got {ep}")
+    if world_size % (tp * pp * ep) != 0:
         raise ValueError(
             f"world size {world_size} is not divisible by tp({tp}) x pp({pp})"
+            f" x ep({ep})"
         )
-    dp = world_size // (tp * pp)
+    dp = world_size // (tp * pp * ep)
     if virtual_pipeline_model_parallel_size is not None and pp < 2:
         raise ValueError(
             "virtual pipeline parallelism requires pipeline_model_parallel_size >= 2"
         )
-    dev_array = np.asarray(devices).reshape(pp, dp, tp)
-    mesh = Mesh(dev_array, (PP_AXIS, DP_AXIS, TP_AXIS))
+    if ep > 1:
+        dev_array = np.asarray(devices).reshape(pp, dp, ep, tp)
+        mesh = Mesh(dev_array, (PP_AXIS, DP_AXIS, EP_AXIS, TP_AXIS))
+    else:
+        dev_array = np.asarray(devices).reshape(pp, dp, tp)
+        mesh = Mesh(dev_array, (PP_AXIS, DP_AXIS, TP_AXIS))
     _GLOBAL_STATE = _MeshState(
         mesh=mesh,
         tensor_model_parallel_size=tp,
         pipeline_model_parallel_size=pp,
         data_parallel_size=dp,
+        expert_model_parallel_size=ep,
         virtual_pipeline_model_parallel_size=virtual_pipeline_model_parallel_size,
         pipeline_model_parallel_split_rank=pipeline_model_parallel_split_rank,
         use_fp8=use_fp8,
@@ -131,6 +156,27 @@ def get_data_parallel_world_size() -> int:
     return _state().data_parallel_size
 
 
+def get_expert_model_parallel_world_size() -> int:
+    return _state().expert_model_parallel_size
+
+
+def get_data_parallel_axis_names() -> tuple:
+    """The mesh axes a data batch (and its grad sync) spans.
+
+    Without expert parallelism this is ("dp",).  With an ep axis the
+    batch shards over BOTH ("dp", "ep") — expert parallelism rides
+    inside the data-parallel world: each ep shard routes its own
+    tokens and the all-to-all exchanges them with its ep peers, so for
+    every non-expert parameter the ep axis is just more data
+    parallelism (docs/moe.md, the routing contract).  Feed the tuple
+    to `ddp.make_train_step(axis_name=...)` / `lax.pmean` — collective
+    primitives take the tuple directly.
+    """
+    if _state().expert_model_parallel_size > 1:
+        return (DP_AXIS, EP_AXIS)
+    return (DP_AXIS,)
+
+
 def get_virtual_pipeline_model_parallel_world_size() -> Optional[int]:
     return _state().virtual_pipeline_model_parallel_size
 
@@ -158,6 +204,13 @@ def get_data_parallel_rank():
     return jax.lax.axis_index(DP_AXIS)
 
 
+def get_expert_model_parallel_rank():
+    """Per-shard ep coordinate; use inside shard_map.  Only valid when
+    the mesh was built with expert_model_parallel_size > 1 (the ep
+    axis does not exist otherwise)."""
+    return jax.lax.axis_index(EP_AXIS)
+
+
 def get_pipeline_model_parallel_rank():
     return jax.lax.axis_index(PP_AXIS)
 
@@ -182,9 +235,12 @@ def get_rank_info() -> str:
     if _GLOBAL_STATE is None:
         return f"proc{jax.process_index()}"
     s = _GLOBAL_STATE
+    ep = (f"/ep{s.expert_model_parallel_size}"
+          if s.expert_model_parallel_size > 1 else "")
     return (
         f"proc{jax.process_index()} dp{s.data_parallel_size}"
-        f"/tp{s.tensor_model_parallel_size}/pp{s.pipeline_model_parallel_size}"
+        f"/tp{s.tensor_model_parallel_size}"
+        f"/pp{s.pipeline_model_parallel_size}{ep}"
     )
 
 

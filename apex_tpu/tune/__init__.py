@@ -23,7 +23,10 @@ optimizer kernels' rows-per-block (ops/optimizer_kernels.py), and the
 serving path (ISSUE 8): `flash_decode` heads_per_step (key:
 decode_attrs) and the paged KV cache's page size (`serve_page`, key:
 serve_page_attrs — the page IS the decode kernel's kv block, so the
-one knob tunes both the DMA unit and the pool granularity).
+one knob tunes both the DMA unit and the pool granularity), and the
+MoE top-k router's row block (`moe_router`, key: moe_router_attrs —
+softmax + top-k are row-independent, so the tuned blocked path is
+byte-identical to the dense reference at every block size).
 """
 
 from apex_tpu.tune.cache import (  # noqa: F401
@@ -77,6 +80,24 @@ def decode_attrs(n_slots, q_len, hq, hkv, d, page_size, dtype):
     return dict(slots=pow2_bucket(n_slots), ql=int(q_len), hq=int(hq),
                 hkv=int(hkv), d=int(d), page=int(page_size),
                 dtype=jnp.dtype(dtype).name)
+
+
+def moe_router_attrs(tokens, n_experts, top_k, dtype):
+    """The ONE definition of the `moe_router` lookup-key attrs — shared
+    by the runtime lookup (moe/router.py) and any sweep driver.  The
+    config carries `block_rows`, the row-block the top-k selection is
+    chunked by (softmax + top_k are row-independent, so every block
+    size is byte-identical to the dense reference — the tuner only
+    moves the VMEM-residency/grid-overhead point).  `tokens` is
+    pow2-bucketed: the local token count is batch-shape-derived and
+    must not fragment the cache across nearby batch sizes.  dtype is
+    the COMPUTE dtype of the incoming activations (the gate logits
+    themselves are always fp32, the DP105 contract)."""
+    import jax.numpy as jnp
+
+    dtype = jnp.bfloat16 if dtype is None else dtype
+    return dict(rows=pow2_bucket(tokens), experts=int(n_experts),
+                k=int(top_k), dtype=jnp.dtype(dtype).name)
 
 
 def serve_page_attrs(n_kv_heads, head_dim, dtype):

@@ -61,26 +61,36 @@ def _retry(fn, *args, attempts=3):
 _SENTRY = {}
 
 
-def _time_steps(step, state, tokens, labels, iters, warmup, name=None):
+def _time_steps(step, state, tokens, labels, iters, warmup, name=None,
+                call=None):
+    """Time `iters` steady-state steps under the RecompileSentry.
+
+    call: optional adapter `(sentry, state) -> (state, loss)` for
+    steps whose signature is not `step(state, tokens, labels)` (the
+    MoE step threads a batch tuple + aux) — the warmup/sync/steady
+    measurement policy stays in this ONE place either way."""
     from apex_tpu.monitor.compile import RecompileSentry
 
     sentry = RecompileSentry(step, name=name or "bench", warn=False)
+    if call is None:
+        def call(s, st):
+            return s(st, tokens, labels)
     for _ in range(warmup):
-        state, loss = sentry(state, tokens, labels)
+        state, loss = call(sentry, state)
     # the sentry replaces the old hand-rolled "warmup 2: donated-state
     # second compile" dance: keep warming (bounded) while the last call
     # still compiled, whatever the reason — layout recompiles included
     extra = 0
     while (extra < 3 and sentry.events
            and sentry.events[-1]["call"] == sentry.calls):
-        state, loss = sentry(state, tokens, labels)
+        state, loss = call(sentry, state)
         extra += 1
     _ = np.asarray(loss)  # full sync (block_until_ready is unreliable
     # through the remote-tunnel backend)
     sentry.mark_steady()
     t0 = time.perf_counter()
     for _ in range(iters):
-        state, loss = sentry(state, tokens, labels)
+        state, loss = call(sentry, state)
     _ = np.asarray(loss)
     dt = (time.perf_counter() - t0) / iters
     if name:
@@ -762,6 +772,64 @@ def _stamp_fleet(result, cycle):
     result["ckpt_commit_barrier_s"] = float(cycle["barrier_s"])
 
 
+def _moe_gpt_bench(on_tpu):
+    """Expert-parallel MoE-GPT training throughput (ISSUE 13): the
+    flagship `models/moe_gpt.py` step — fp32 top-k router, capacity-
+    factor dispatch into the static (E, C, H) buffer, ONE all_to_all
+    over the ep axis each way, ZeRO-2 master state over the combined
+    (dp, ep) axes — built by the SAME shared builder the lint/comms
+    gates trace (`build_moe_train_step`; ep=2 on any even device
+    count, CPU smoke shapes off-TPU) and timed under the
+    RecompileSentry (a routing-dependent recompile would measure XLA,
+    not training — the zero-steady-recompile acceptance criterion).
+    Returns the dict `_stamp_moe` folds into the result: tokens/s plus
+    the last step's aux scalars (drop fraction, load-balance loss,
+    gate entropy)."""
+    from apex_tpu.models.moe_gpt import build_moe_train_step
+    from apex_tpu.parallel import mesh as M
+
+    model, step, args, info = build_moe_train_step(on_tpu)
+    state, _, (tok_sds, _) = args
+    tokens = jax.random.randint(jax.random.PRNGKey(1), tok_sds.shape,
+                                0, info["vocab_size"])
+    labels = jnp.roll(tokens, -1, axis=1)
+    iters, warmup = (20, 3) if on_tpu else (3, 1)
+    last = {}
+
+    def call(sentry, st):
+        st, _, loss, aux = sentry(st, None, (tokens, labels))
+        last["aux"] = aux
+        return st, loss
+
+    dt = _time_steps(step, state, None, None, iters, warmup,
+                     name="moe_gpt", call=call)
+    aux_host = {k: float(v)
+                for k, v in jax.device_get(last["aux"]).items()}
+    M.destroy_model_parallel()
+    cfg = info["config"]
+    return {
+        "tokens_per_sec": round(info["batch"] * info["seq"] / dt, 1),
+        "dp": info["dp"], "ep": info["ep"],
+        "n_experts": cfg.n_experts, "top_k": cfg.top_k,
+        "capacity_factor": cfg.capacity_factor,
+        "drop_fraction": round(aux_host["moe_drop_fraction"], 6),
+        "aux_loss": round(aux_host["moe_aux_loss"], 6),
+        "gate_entropy": round(aux_host["moe_gate_entropy"], 6),
+        "z_loss": round(aux_host["moe_z_loss"], 6),
+    }
+
+
+def _stamp_moe(result, d):
+    """Flat v9 `moe_*` scalars (the prefix is JSON-scalar-reserved,
+    the `comms_`/`serve_` rule) + the full dict under `moe_gpt`."""
+    result["moe_gpt"] = d
+    result["moe_tokens_per_sec"] = float(d["tokens_per_sec"])
+    result["moe_drop_fraction"] = float(d["drop_fraction"])
+    result["moe_aux_loss"] = float(d["aux_loss"])
+    result["moe_gate_entropy"] = float(d["gate_entropy"])
+    result["moe_z_loss"] = float(d["z_loss"])
+
+
 def _adam_1b_step_ms(on_tpu):
     """Fused flat-buffer Adam step at 1B params (fp32 p/m/v, bf16
     grads) — the large-param optimizer north star (BASELINE.md;
@@ -1019,6 +1087,16 @@ def main():
                                                on_tpu)
     except Exception as e:
         result["zero2_n_buckets_error"] = repr(e)[:120]
+    # expert-parallel MoE training (ISSUE 13): dp x ep MoE-GPT
+    # tokens/s under the RecompileSentry, plus the routing-health aux
+    # scalars (_stamp_moe: flat moe_* v9 scalars + the dict under
+    # `moe_gpt`)
+    try:
+        with _timed(durations, "moe_gpt"):
+            moe_d = _retry(_moe_gpt_bench, on_tpu)
+        _stamp_moe(result, moe_d)
+    except Exception as e:
+        result["moe_error"] = repr(e)[:120]
     # serving axes (ISSUE 8): decode tokens/s + p50/p99 per-token
     # latency at N concurrent streams, and the sentry's churn verdict
     # (_stamp_serve: flat serve_* scalars + the full sweep dict)

@@ -75,6 +75,8 @@ _FIXTURE_MARKERS = (
     "=== comms: fixture-step ===",
     "| all-reduce",
     "| reduce-scatter",
+    "| all-to-all",
+    "| ep ",
     "**SER**",
     "SERIALIZED collective(s)",
     "roofline: predicted comm",
@@ -201,14 +203,33 @@ def _build_serve():
     return eng.decode_step, (eng.params, eng.kv, eng.state)
 
 
+def _build_moe():
+    """The flagship expert-parallel MoE-GPT step (apex_tpu.moe, ISSUE
+    13): meshed over ALL visible devices (ep = 2 on any even device
+    count, dp = world/ep; batch rounded to a dp x ep multiple by the
+    builder), ZeRO-2 state over the combined data axes.  The
+    inventory must show the dispatch/combine all-to-alls over ['ep']
+    priced by the ring formula ((n-1)/n * D / bw) — the seeded
+    pattern in scripts/comms_fixture.json — next to the per-bucket
+    reduce-scatters over the combined grad-sync axes."""
+    import jax
+
+    from apex_tpu.models.moe_gpt import build_moe_train_step
+
+    on_tpu = jax.default_backend() not in ("cpu",)
+    _, step, args, _ = build_moe_train_step(on_tpu)
+    return step, args
+
+
 BUILDERS = {
     "gpt_zero2": lambda: _build_gpt_zero2(
         __import__("jax").default_backend() not in ("cpu",)),
     "gpt": lambda: _build_anatomy("350m"),
     "bert": lambda: _build_anatomy("bert"),
     "serve": _build_serve,
+    "moe": _build_moe,
 }
-DEFAULT_TARGETS = ("gpt_zero2", "gpt", "serve")
+DEFAULT_TARGETS = ("gpt_zero2", "gpt", "serve", "moe")
 
 
 def _gate_report(rep_dict, target, allowlist, as_json) -> int:

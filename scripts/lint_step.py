@@ -35,6 +35,16 @@ sys.path.insert(1, os.path.dirname(os.path.abspath(__file__)))
 # tracing is host-side; never let a pinned TPU tunnel stall the gate
 # unless the operator explicitly asked for device truth
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# the moe target needs a dp x ep mesh: on the CPU backend force a
+# 4-way virtual mesh (must precede the first jax import, conftest-
+# style; the other targets build single-device meshes and are
+# unaffected by extra visible devices)
+if os.environ.get("JAX_PLATFORMS") == "cpu" and \
+        "xla_force_host_platform_device_count" not in \
+        os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=4").strip()
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _ROOT = os.path.dirname(_HERE)
@@ -46,10 +56,12 @@ FIXTURE = os.path.join(_HERE, "lint_fixture.json")
 _FIXTURE_MARKERS = (
     "=== lint: fixture-step ===",
     "ERROR   CL201",
+    "ERROR   CL206",
     "WARNING DP101",
+    "WARNING DP105",
     "HS401 examples/broken.py:12",
     "fix: cast the operands",
-    "3 new finding(s), 2 error(s)",
+    "5 new finding(s), 3 error(s)",
     "(1 allowlisted finding(s) accepted)",
 )
 
@@ -154,8 +166,21 @@ def _build_serve(on_tpu):
     return eng.decode_step, (eng.params, eng.kv, eng.state)
 
 
+def _build_moe(on_tpu):
+    """The flagship expert-parallel MoE-GPT step (apex_tpu.moe, ISSUE
+    13): dp x ep mesh over all visible devices, ZeRO-2 master state
+    sharded over the combined data axes, dispatch/combine all_to_alls
+    over ep — the program the CL206/DP105 rules exist to hold.  Built
+    via the shared builder (the exact bench program)."""
+    from apex_tpu.models.moe_gpt import build_moe_train_step
+
+    _, step, args, _ = build_moe_train_step(on_tpu)
+    return step, args
+
+
 BUILDERS = {"gpt": _build_gpt, "bert": _build_bert,
-            "resnet": _build_resnet, "serve": _build_serve}
+            "resnet": _build_resnet, "serve": _build_serve,
+            "moe": _build_moe}
 
 
 def main() -> int:

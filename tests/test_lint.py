@@ -197,6 +197,77 @@ def test_cl205_dead_collective():
     assert rules_of(fs) == ["CL205"]
 
 
+def test_cl206_all_to_all_wrong_axis():
+    """Expert dispatch/combine traffic off the ep axis (ISSUE 13): an
+    all_to_all riding dp while the mesh carries ep is the silent
+    token-scrambling transposition CL206 exists for."""
+    def wrong(x):
+        return jax.lax.all_to_all(x, "dp", split_axis=0, concat_axis=1,
+                                  tiled=True)
+
+    fs = lint.lint_program(wrong, (SDS((8, 8), jnp.float32),),
+                           axis_env=[("dp", 2), ("ep", 2)])
+    assert "CL206" in rules_of(fs)
+    hit = next(f for f in fs if f.rule == "CL206")
+    assert hit.severity == "error" and "all_to_all[0]" in hit.location
+
+    # the conforming exchange — over ep — is clean
+    def ok(x):
+        return jax.lax.all_to_all(x, "ep", split_axis=0, concat_axis=1,
+                                  tiled=True)
+
+    assert "CL206" not in rules_of(lint.lint_program(
+        ok, (SDS((8, 8), jnp.float32),),
+        axis_env=[("dp", 2), ("ep", 2)]))
+    # without any ep axis in sight, a dp all_to_all is legal
+    assert "CL206" not in rules_of(lint.lint_program(
+        wrong, (SDS((8, 8), jnp.float32),), axis_env=[("dp", 2)]))
+
+    # a NON-dp all_to_all (the Ulysses cp head-scatter) is legitimate
+    # non-expert traffic even when the mesh carries ep
+    def ulysses(x):
+        return jax.lax.all_to_all(x, "cp", split_axis=0, concat_axis=1,
+                                  tiled=True)
+
+    assert "CL206" not in rules_of(lint.lint_program(
+        ulysses, (SDS((8, 8), jnp.float32),),
+        axis_env=[("dp", 2), ("cp", 2), ("ep", 2)]))
+
+
+def test_cl206_all_to_all_undeclared_axis():
+    def f(x):
+        return jax.lax.all_to_all(x, "zz", split_axis=0, concat_axis=1,
+                                  tiled=True)
+
+    fs = lint.lint_program(
+        f, (SDS((8, 8), jnp.float32),), axis_env=[("zz", 2)],
+        config=LintConfig(expected_axes=("dp", "ep")))
+    assert "CL206" in rules_of(fs)
+
+
+def test_dp105_low_precision_router_selection():
+    """A bf16 router softmax feeding top_k is a finding; the
+    apex_tpu.moe contract — bf16 gate GEMM operands with fp32
+    accumulation, fp32 softmax + selection — is clean."""
+    def bad(x, wg):
+        probs = jax.nn.softmax(jnp.dot(x, wg), axis=-1)  # bf16 end-to-end
+        g, _ = jax.lax.top_k(probs, 2)
+        return g.sum()
+
+    fs = lint.lint_program(
+        bad, (SDS((64, 32), jnp.bfloat16), SDS((32, 8), jnp.bfloat16)))
+    assert "DP105" in rules_of(fs)
+    assert "top_k" in next(f for f in fs if f.rule == "DP105").location
+
+    def good(x, wg):
+        from apex_tpu.moe.router import topk_gates_dense
+        out = topk_gates_dense(x, wg, 2)
+        return out.gate.sum()
+
+    assert "DP105" not in rules_of(lint.lint_program(
+        good, (SDS((64, 32), jnp.bfloat16), SDS((32, 8), jnp.bfloat16))))
+
+
 # ------------------------- donation pass -------------------------
 
 def _smoke_ddp_step(donate):
@@ -490,9 +561,11 @@ def test_lint_step_selftest():
 
 def test_lint_step_cli_flagships_clean():
     """The acceptance gate: `scripts/lint_step.py` exits 0 on the
-    flagship GPT/BERT step functions with the EMPTY committed
-    allowlist."""
+    flagship GPT/BERT/serve/MoE step functions with the EMPTY
+    committed allowlist (the MoE step is the ISSUE 13 acceptance
+    criterion: its ep all_to_alls and fp32 router must clear the
+    CL206/DP105 rules built for them)."""
     r = _run_script(ROOT / "scripts" / "lint_step.py", "gpt", "bert",
-                    "serve")
+                    "serve", "moe")
     assert r.returncode == 0, r.stdout + r.stderr
     assert "CLEAN" in r.stdout

@@ -1,0 +1,528 @@
+"""apex_tpu.moe — expert-parallel Mixture-of-Experts (ISSUE 13).
+
+The coverage the ISSUE names: router top-k vs the dense reference
+(fp32, ties pinned by index), dispatch/combine round-trip bitwise at
+capacity_factor=inf, the MoE train step bitwise-equal to the dense GPT
+step at n_experts=1/top_k=1, dp x ep grid parity against a
+single-device oracle, aux-loss gradients finite under amp dynamic
+scaling — plus the RecompileSentry zero-steady-recompile acceptance
+gate, the ep-layout checkpoint refusal BY NAME, the all-to-all
+roofline formula against MoE payload sizes, and the flight-recorder
+moe taps.
+"""
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu.models.gpt import GPT, GPTConfig
+from apex_tpu.models.moe_gpt import (
+    MoEGPT,
+    MoEGPTConfig,
+    build_moe_train_step,
+    moe_smoke_config,
+)
+from apex_tpu.moe import dispatch as D
+from apex_tpu.moe import router as R
+from apex_tpu.optimizers.distributed_fused_adam import DistributedFusedAdam
+from apex_tpu.parallel import ddp
+from apex_tpu.parallel import mesh as M
+
+
+def _tree_leaves_named(tree):
+    import jax.tree_util as jtu
+    return {jtu.keystr(p): np.asarray(v)
+            for p, v in jtu.tree_flatten_with_path(tree)[0]}
+
+
+# ------------------------------ router ------------------------------
+
+def test_router_topk_matches_dense_reference():
+    """Blocked path byte-identical to the dense reference at every
+    block size; gates/probs/logits are fp32 regardless of input dtype."""
+    x = jax.random.normal(jax.random.PRNGKey(0), (37, 16), jnp.bfloat16)
+    wg = jax.random.normal(jax.random.PRNGKey(1), (16, 8),
+                           jnp.bfloat16) * 0.1
+    ref = R.topk_gates_dense(x, wg, 2)
+    assert ref.probs.dtype == jnp.float32
+    assert ref.gate.dtype == jnp.float32
+    assert ref.logits.dtype == jnp.float32
+    for blk in (8, 16, 64):
+        out = R.topk_gates_blocked(x, wg, 2, blk)
+        for f in ref._fields:
+            assert np.array_equal(np.asarray(getattr(ref, f)),
+                                  np.asarray(getattr(out, f))), (f, blk)
+
+
+def test_router_ties_pinned_by_index():
+    """Equal gate probabilities resolve to the LOWER expert index —
+    routing must be backend-independent."""
+    logits = jnp.zeros((5, 4), jnp.float32)  # all tied
+    probs = jax.nn.softmax(logits, axis=-1)
+    gate, idx = jax.lax.top_k(probs, 2)
+    assert np.array_equal(np.asarray(idx),
+                          np.tile([0, 1], (5, 1)))
+    np.testing.assert_array_equal(np.asarray(gate), 0.25)
+
+
+def test_router_tuner_op_byte_identical():
+    """A tuned `moe_router` block_rows hit changes scheduling only —
+    the tune/ contract (heuristic fallback == tuned, byte-identical)."""
+    from apex_tpu import tune
+    from apex_tpu.tune.search import forced
+
+    x = jax.random.normal(jax.random.PRNGKey(0), (40, 16), jnp.float32)
+    wg = jax.random.normal(jax.random.PRNGKey(1), (16, 4),
+                           jnp.float32) * 0.1
+    miss = R.topk_gates(x, wg, 2)          # cache miss -> dense path
+    attrs = tune.moe_router_attrs(40, 4, 2, x.dtype)
+    with forced("moe_router", attrs, {"block_rows": 16}):
+        hit = R.topk_gates(x, wg, 2)
+    for f in miss._fields:
+        assert np.array_equal(np.asarray(getattr(miss, f)),
+                              np.asarray(getattr(hit, f))), f
+
+
+def test_expert_capacity_math():
+    assert R.expert_capacity(64, 4, 2, float("inf")) == 64
+    assert R.expert_capacity(64, 4, 2, 1.0) == 32
+    # rounds up to the sublane, clamps to tokens
+    assert R.expert_capacity(100, 8, 1, 1.0) % 8 == 0
+    assert R.expert_capacity(10, 2, 1, 100.0) == 10
+    with pytest.raises(ValueError):
+        R.expert_capacity(64, 4, 2, 0.0)
+
+
+# ------------------------- dispatch/combine -------------------------
+
+def test_dispatch_combine_roundtrip_bitwise():
+    """capacity_factor=inf, k=1, unit gates: scatter -> exchange(ep=1)
+    -> combine reproduces every token bit-for-bit."""
+    t, h, e = 24, 8, 4
+    x = jax.random.normal(jax.random.PRNGKey(2), (t, h), jnp.float32)
+    idx = jax.random.randint(jax.random.PRNGKey(3), (t, 1), 0, e)
+    cap = R.expert_capacity(t, e, 1, float("inf"))
+    dest, dropped = R.capacity_destinations(idx, e, cap)
+    assert float(np.asarray(dropped).sum()) == 0.0
+    buf = D.dispatch(x, dest, e, cap)
+    xe = D.exchange_dispatch(buf, "ep", 1, e, cap)
+    ybuf = D.exchange_combine(xe, "ep", 1, e, cap)
+    y = D.combine(ybuf, dest, jnp.ones((t, 1), jnp.float32))
+    assert np.array_equal(np.asarray(y), np.asarray(x))
+
+
+def test_dispatch_combine_roundtrip_bitwise_ep2():
+    """The same round trip THROUGH the ep all_to_all pair on a real
+    dp=2 x ep=2 mesh — the exchange must be an exact inverse."""
+    e, h = 4, 8
+    mesh = M.initialize_model_parallel(expert_model_parallel_size=2,
+                                       devices=jax.devices()[:4])
+
+    def f(xs):
+        t = xs.shape[0]
+        idx = (jnp.arange(t)[:, None] * 3) % e
+        cap = R.expert_capacity(t, e, 1, float("inf"))
+        dest, _ = R.capacity_destinations(idx, e, cap)
+        buf = D.dispatch(xs, dest, e, cap)
+        xe = D.exchange_dispatch(buf, "ep", 2, e, cap)
+        ybuf = D.exchange_combine(xe, "ep", 2, e, cap)
+        return D.combine(ybuf, dest, jnp.ones((t, 1), jnp.float32))
+
+    x = jax.random.normal(jax.random.PRNGKey(4), (16, h), jnp.float32)
+    out = jax.jit(shard_map(f, mesh=mesh, in_specs=(P(("dp", "ep")),),
+                            out_specs=P(("dp", "ep")),
+                            check_vma=False))(x)
+    assert np.array_equal(np.asarray(out), np.asarray(x))
+
+
+def test_capacity_dropping_routes_to_trash():
+    """Over-capacity assignments land on the trash row and contribute
+    exactly zero at combine; kept rows are untouched."""
+    t, h, e, cap = 8, 4, 2, 2
+    x = jnp.arange(t * h, dtype=jnp.float32).reshape(t, h) + 1.0
+    idx = jnp.zeros((t, 1), jnp.int32)          # everyone wants expert 0
+    dest, dropped = R.capacity_destinations(idx, e, cap)
+    assert float(np.asarray(dropped).sum()) == t - cap
+    assert np.all(np.asarray(dest[cap:, 0]) == e * cap)  # trash
+    buf = D.dispatch(x, dest, e, cap)
+    ybuf = D.exchange_combine(
+        D.exchange_dispatch(buf, "ep", 1, e, cap), "ep", 1, e, cap)
+    y = D.combine(ybuf, dest, jnp.ones((t, 1), jnp.float32))
+    assert np.array_equal(np.asarray(y[:cap]), np.asarray(x[:cap]))
+    assert np.all(np.asarray(y[cap:]) == 0.0)   # dropped -> zeros
+
+
+# --------------------- the dense-GPT bitwise anchor ---------------------
+
+def _map_dense_into_moe(dense_params, moe_params, n_layers):
+    for i in range(n_layers):
+        bp, dbp = moe_params[f"block{i}"], dense_params[f"block{i}"]
+        bp["moe"]["w1"] = dbp["fc1"]["weight"][None]
+        bp["moe"]["b1"] = dbp["fc1"]["bias"][None]
+        bp["moe"]["w2"] = dbp["fc2"]["weight"][None]
+        bp["moe"]["b2"] = dbp["fc2"]["bias"][None]
+
+
+def test_moe_step_bitwise_equals_dense_gpt_step():
+    """The acceptance anchor: at n_experts=1 / top_k=1 / cf=inf /
+    aux=z=0 the full ZeRO-2 train step — loss AND every updated
+    parameter — is bitwise the dense GPT step's, three steps deep."""
+    kw = dict(vocab_size=512, seq_len=32, hidden=32, num_layers=2,
+              num_heads=4, dropout=0.0)
+    dense_cfg = GPTConfig(**kw)
+    moe_cfg = MoEGPTConfig(n_experts=1, top_k=1,
+                           capacity_factor=float("inf"),
+                           aux_coef=0.0, z_coef=0.0, **kw)
+    mesh = M.initialize_model_parallel(devices=jax.devices()[:2])
+    dense, moe = GPT(dense_cfg), MoEGPT(moe_cfg)
+    dp_params = dense.init(jax.random.PRNGKey(0))
+    mp_params = moe.init(jax.random.PRNGKey(0))
+    _map_dense_into_moe(dp_params, mp_params, 2)
+
+    def build(model, params, has_aux):
+        opt = DistributedFusedAdam(num_shards=2, lr=1e-3, n_buckets=2)
+        sspec = opt.state_partition_specs()
+        state = jax.jit(shard_map(opt.init, mesh=mesh, in_specs=(P(),),
+                                  out_specs=sspec, check_vma=False))(
+                                      params)
+        if has_aux:
+            def loss_fn(p, b):
+                return model.loss_with_stats(p, b[0], b[1])
+        else:
+            def loss_fn(p, b):
+                return model.loss(p, b[0], b[1])
+        step = ddp.make_train_step(loss_fn, opt, mesh, has_aux=has_aux,
+                                   batch_spec=(P("dp"), P("dp")))
+        return opt, state, step
+
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 32), 0, 512)
+    labels = jnp.roll(tokens, -1, axis=1)
+    opt_d, st_d, step_d = build(dense, dp_params, False)
+    opt_m, st_m, step_m = build(moe, mp_params, True)
+    for it in range(3):
+        st_d, _, loss_d = step_d(st_d, None, (tokens, labels))
+        st_m, _, loss_m, aux = step_m(st_m, None, (tokens, labels))
+        assert np.array_equal(np.asarray(loss_d), np.asarray(loss_m)), \
+            f"loss diverged at step {it}"
+    assert float(aux["moe_drop_fraction"]) == 0.0
+    assert float(aux["moe_aux_loss"]) == 1.0  # E=1: perfectly balanced
+
+    def gather(opt, st):
+        return jax.jit(shard_map(
+            lambda s: opt.full_params(s), mesh=mesh,
+            in_specs=(opt.state_partition_specs(),), out_specs=P(),
+            check_vma=False))(st)
+
+    ld = _tree_leaves_named(gather(opt_d, st_d))
+    lm = _tree_leaves_named(gather(opt_m, st_m))
+    for k in sorted(ld):
+        km = (k.replace("fc1']['weight", "moe']['w1")
+               .replace("fc1']['bias", "moe']['b1")
+               .replace("fc2']['weight", "moe']['w2")
+               .replace("fc2']['bias", "moe']['b2"))
+        assert np.array_equal(ld[k], lm[km].reshape(ld[k].shape)), k
+
+
+# ------------------------- dp x ep grid parity -------------------------
+
+def test_dp_ep_grid_parity_vs_single_device_oracle():
+    """dp=4 x ep=2 over 8 devices vs one device holding the whole
+    batch: identical routing decisions (cf leaves no drops at these
+    shapes), loss allclose (reduction order only)."""
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (8, 64), 0, 512)
+    labels = jnp.roll(tokens, -1, axis=1)
+
+    cfg1 = moe_smoke_config(ep=1, aux_coef=0.0, z_coef=1e-3)
+    mesh1 = M.initialize_model_parallel(devices=jax.devices()[:1])
+    m1 = MoEGPT(cfg1)
+    p1 = m1.init(jax.random.PRNGKey(0))
+    loss1 = jax.jit(shard_map(
+        lambda p, b: m1.loss(p, b[0], b[1]).reshape(1), mesh=mesh1,
+        in_specs=(P(), (P(), P())), out_specs=P(),
+        check_vma=False))(p1, (tokens, labels))
+
+    M.destroy_model_parallel()
+    cfg2 = moe_smoke_config(ep=2, aux_coef=0.0, z_coef=1e-3)
+    mesh2 = M.initialize_model_parallel(expert_model_parallel_size=2)
+    assert M.get_data_parallel_world_size() == 4
+    m2 = MoEGPT(cfg2)
+    p2 = m2.init(jax.random.PRNGKey(0))
+
+    def dloss(p, b):
+        return jax.lax.pmean(m2.loss(p, b[0], b[1]),
+                             ("dp", "ep")).reshape(1)
+
+    loss2 = jax.jit(shard_map(
+        dloss, mesh=mesh2,
+        in_specs=(P(), (P(("dp", "ep")), P(("dp", "ep")))),
+        out_specs=P(), check_vma=False))(p2, (tokens, labels))
+    np.testing.assert_allclose(float(loss1[0]), float(loss2[0]),
+                               rtol=2e-5)
+
+
+# ---------------------- the flagship train step ----------------------
+
+def test_moe_train_step_zero_steady_recompiles():
+    """The acceptance criterion: models/moe_gpt.py trains under
+    ddp.make_train_step on a dp x ep CPU mesh with ZERO steady-state
+    recompiles and a decreasing loss."""
+    from apex_tpu.monitor.compile import RecompileSentry
+
+    model, step, args, info = build_moe_train_step(False)
+    assert info["ep"] == 2  # the 8-way test mesh always splits
+    state, _, (tok_sds, _) = args
+    tokens = jax.random.randint(jax.random.PRNGKey(1), tok_sds.shape,
+                                0, info["vocab_size"])
+    labels = jnp.roll(tokens, -1, axis=1)
+    sentry = RecompileSentry(step, name="moe_gpt", warn=False)
+    losses = []
+    for i in range(4):
+        state, _, loss, aux = sentry(state, None, (tokens, labels))
+        losses.append(float(loss))
+        if i == 0:
+            sentry.mark_steady()
+    assert sentry.steady_recompiles == 0, sentry.events
+    assert losses[-1] < losses[0]
+    for k, v in aux.items():
+        assert math.isfinite(float(v)), (k, float(v))
+
+
+def test_aux_loss_grad_finite_under_amp_dynamic_scaling():
+    """Aux-loss gradients (router path included) stay finite under
+    dynamic loss scaling at the 2^16 initial scale; no overflow-skip
+    fires on the smoke shapes."""
+    from apex_tpu import amp
+
+    cfg = moe_smoke_config(ep=1, aux_coef=1e-2, z_coef=1e-3)
+    mesh = M.initialize_model_parallel(devices=jax.devices()[:2])
+    model = MoEGPT(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+    opt = DistributedFusedAdam(num_shards=2, lr=1e-4, n_buckets=1)
+    sspec = opt.state_partition_specs()
+    state = jax.jit(shard_map(opt.init, mesh=mesh, in_specs=(P(),),
+                              out_specs=sspec, check_vma=False))(params)
+    amp_state = amp.initialize(opt_level="O1")
+    scaler = amp_state.loss_scalers[0]
+
+    def loss_fn(p, b):
+        return model.loss_with_stats(p, b[0], b[1])
+
+    step = ddp.make_train_step(loss_fn, opt, mesh, amp_state=amp_state,
+                               has_aux=True,
+                               batch_spec=(P("dp"), P("dp")),
+                               metrics=True)
+    from apex_tpu.monitor import init_metrics
+    mstate = init_metrics()
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 64), 0,
+                                cfg.vocab_size)
+    labels = jnp.roll(tokens, -1, axis=1)
+    for _ in range(3):
+        state, scaler, loss, aux, mstate = step(
+            state, scaler, (tokens, labels), mstate)
+    assert math.isfinite(float(loss))
+    for k, v in aux.items():
+        assert math.isfinite(float(v)), k
+    m = jax.device_get(mstate)
+    assert math.isfinite(float(m.grad_norm)) and float(m.grad_norm) > 0
+    assert int(m.overflow_count) == 0
+    assert float(scaler.scale) == 2.0 ** 16
+
+
+def test_moe_taps_ride_tap_state_plane():
+    """The block{i}/moe taps (per-expert load / drop / gate entropy)
+    flow through the existing TapState plane; the untapped step is
+    numerically untouched."""
+    model, step, args, info = build_moe_train_step(False, trace=True)
+    state, _, (tok_sds, _) = args
+    tokens = jax.random.randint(jax.random.PRNGKey(1), tok_sds.shape,
+                                0, info["vocab_size"])
+    labels = jnp.roll(tokens, -1, axis=1)
+    state, _, loss, aux, tap_state = step(state, None, (tokens, labels))
+    names = step.tap_names()
+    for want in ("block0/moe/load", "block0/moe/drop",
+                 "block1/moe/gate_entropy"):
+        assert want in names
+    st = jax.device_get(tap_state)
+    load = st.fwd[names.index("block0/moe/load")]
+    n_exp = info["config"].n_experts
+    np.testing.assert_allclose(load[1], 1.0 / n_exp, rtol=1e-5)  # mean
+    ent = st.fwd[names.index("block0/moe/gate_entropy")]
+    assert 0 < ent[1] <= math.log(n_exp) + 1e-5
+
+    _, step2, args2, _ = build_moe_train_step(False)
+    st2, _, loss2, _ = step2(args2[0], None, (tokens, labels))
+    assert np.array_equal(np.asarray(loss), np.asarray(loss2))
+
+
+# ----------------------- checkpoint ep refusal -----------------------
+
+def test_restore_refuses_ep_layout_by_name(tmp_path):
+    """A dp=2 x ep=2 manifest must be REFUSED by a dp=4 dense target
+    with a LayoutMismatchError naming the ep axis — never silently
+    concatenated (the elastic re-shard contract is dp-only)."""
+    from apex_tpu.checkpoint import sharded as S
+
+    n, dp_ep = 64, 4
+    layout = {"align": 1, "total": n, "n_tensors": 1,
+              "num_shards": dp_ep, "n_buckets": 1,
+              "bucket_totals": [n], "bucket_padded": [n],
+              "master_dtype": "float32", "ep_shards": 2}
+    flat = np.arange(n, dtype=np.float32)
+    shards = [flat[r * n // dp_ep:(r + 1) * n // dp_ep]
+              for r in range(dp_ep)]
+    S.save_sharded(str(tmp_path), 3,
+                   {"params_shard": ("sharded", shards)},
+                   flat_layout=layout)
+
+    dense_dst = dict(layout, num_shards=4)
+    dense_dst.pop("ep_shards")
+    with pytest.raises(S.LayoutMismatchError, match="'ep'|ep="):
+        S.reshard(shards, layout, dense_dst)
+
+    class FakeDenseOpt:
+        axis_name = "dp"
+
+        def shard_layout(self):
+            return dense_dst
+
+        _STATE = None
+
+    with pytest.raises(S.LayoutMismatchError) as ei:
+        S.restore_sharded(str(tmp_path), FakeDenseOpt())
+    assert "ep" in str(ei.value)
+
+    # the SAME ep layout restores fine (dp elasticity untouched)
+    class FakeEpOpt(FakeDenseOpt):
+        def shard_layout(self):
+            return dict(layout)
+
+    state, scaler, manifest = S.restore_sharded(str(tmp_path),
+                                                FakeEpOpt())
+    assert np.array_equal(np.asarray(state["params_shard"]), flat)
+
+
+def test_moe_zero_state_checkpoint_roundtrip(tmp_path):
+    """CheckpointManager saves the (dp, ep)-sharded flat state with
+    ep_shards recorded in the manifest, and the same-topology restore
+    is bitwise."""
+    from apex_tpu.checkpoint import CheckpointManager
+
+    model, step, args, info = build_moe_train_step(False)
+    world = info["dp"] * info["ep"]
+    opt = DistributedFusedAdam(num_shards=world, lr=1e-4, n_buckets=2,
+                               axis_name=("dp", "ep"),
+                               ep_shards=info["ep"])
+    params = model.init(jax.random.PRNGKey(0))
+    mesh = info["mesh"]
+    sspec = opt.state_partition_specs()
+    state = jax.jit(shard_map(opt.init, mesh=mesh, in_specs=(P(),),
+                              out_specs=sspec, check_vma=False))(params)
+    mgr = CheckpointManager(str(tmp_path), opt, every_n_steps=1)
+    mgr.save(1, state)
+    mgr.wait()
+    from apex_tpu.checkpoint import sharded as S
+    man = S.read_manifest(S.step_dir(str(tmp_path), 1))
+    assert man["flat_layout"]["ep_shards"] == info["ep"]
+    restored, _, _ = mgr.restore(mesh)
+    for f in state._fields:
+        assert np.array_equal(np.asarray(getattr(restored, f)),
+                              np.asarray(getattr(state, f))), f
+
+
+# ------------------------- comms roofline -------------------------
+
+def test_all_to_all_roofline_formula_moe_payloads():
+    """The ring all-to-all price ((n-1)/n * D / bw) against the real
+    MoE exchange payload sizes: D = E * C * H * itemsize per
+    direction."""
+    from apex_tpu.monitor.comms.roofline import collective_seconds
+
+    bw = 200e9
+    for (e, cap, h, itemsize, ep) in (
+            (8, 256, 1024, 2, 2),      # bench bf16 shape
+            (8, 256, 1024, 2, 4),
+            (4, 64, 64, 4, 2)):        # smoke fp32 shape
+        payload = e * cap * h * itemsize
+        got = collective_seconds("all-to-all", payload, ep, bw)
+        want = (ep - 1) / ep * payload / bw
+        assert got == pytest.approx(want, rel=1e-12)
+    # degenerate ep=1 exchange costs nothing (and traces no collective)
+    assert collective_seconds("all-to-all", 1 << 20, 1, bw) == 0.0
+
+
+# ------------------------- telemetry plane -------------------------
+
+def test_metrics_logger_stamps_moe_fields():
+    """SCHEMA v9: `MetricsLogger(moe=recorder)` stamps the moe_*
+    scalars once the trainer fed the recorder a step's aux; before
+    that nothing is stamped (the OPTIONAL-never-null rule)."""
+    from apex_tpu import monitor
+    from apex_tpu.moe import MoEAux, MoERecorder
+
+    assert monitor.SCHEMA_VERSION >= 9
+    rec = MoERecorder()
+    logger = monitor.MetricsLogger([], moe=rec)
+    mstate = monitor.init_metrics()
+    r1 = logger.log_step(mstate)
+    assert "moe_aux_loss" not in r1  # nothing fed yet
+
+    rec.update(MoEAux(aux_loss=jnp.float32(1.25),
+                      z_loss=jnp.float32(0.5),
+                      drop_fraction=jnp.float32(0.03),
+                      gate_entropy=jnp.float32(1.1)))
+    mstate = mstate._replace(step=mstate.step + 1)
+    r2 = logger.log_step(mstate)
+    assert r2["moe_aux_loss"] == 1.25
+    assert r2["moe_drop_fraction"] == pytest.approx(0.03)
+    assert r2["moe_gate_entropy"] == pytest.approx(1.1)
+    monitor.validate_records([r1, r2])
+
+
+# ------------------------- config validation -------------------------
+
+def test_moe_config_validation():
+    with pytest.raises(ValueError, match="sequence_parallel"):
+        MoEGPTConfig(sequence_parallel=True)
+    with pytest.raises(ValueError, match="remat"):
+        MoEGPTConfig(remat=True)
+    with pytest.raises(ValueError, match="divide"):
+        MoEGPTConfig(n_experts=3, expert_parallel=2)
+    with pytest.raises(ValueError, match="top_k"):
+        from apex_tpu.moe.layer import MoEMLP
+        MoEMLP(8, 32, 2, top_k=4)
+
+
+def test_zero_optimizers_take_ep_shards():
+    """BOTH ZeRO optimizers carry the ep annotation the checkpoint
+    refusal keys on (a LAMB MoE run must be just as refusable)."""
+    from apex_tpu.optimizers.distributed_fused_adam import (
+        DistributedFusedLAMB,
+    )
+    opt = DistributedFusedLAMB(num_shards=4, axis_name=("dp", "ep"),
+                               ep_shards=2)
+    assert opt.ep_shards == 2
+    with pytest.raises(ValueError, match="ep_shards"):
+        DistributedFusedLAMB(num_shards=4, ep_shards=3)
+    with pytest.raises(ValueError, match="ep_shards"):
+        DistributedFusedAdam(num_shards=4, ep_shards=3)
+
+
+def test_moe_refuses_tensor_parallel_mesh():
+    """tp > 1 must raise LOUDLY at trace time (experts replicate over
+    tp; the RowParallel-style reduce would scale outputs by tp)."""
+    cfg = moe_smoke_config(ep=1)
+    mesh = M.initialize_model_parallel(tensor_model_parallel_size=2)
+    model = MoEGPT(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 64), 0,
+                                cfg.vocab_size)
+    with pytest.raises(NotImplementedError, match="tensor parallelism"):
+        jax.jit(shard_map(
+            lambda p, t: model.loss(p, t, t), mesh=mesh,
+            in_specs=(P(), P("dp")), out_specs=P(),
+            check_vma=False)).lower(params, tokens)
