@@ -68,7 +68,17 @@ CKPT_POINTS = ("ckpt.before_shards", "ckpt.mid_shards",
 # ckpt.* shard-write points fire inside the multi-host writer too).
 HOST_POINTS = ("host.before_submanifest", "host.before_barrier",
                "rank.lost_at_step")
-POINTS = CKPT_POINTS + HOST_POINTS  # everything arm() accepts
+# serving-plane fail points (ISSUE 14).  `serve.kill_mid_drain` is a
+# classic raise-style kill (checked by `DecodeEngine.drain`'s loop);
+# the other two are INJECTION points consumed via `fire()` — their
+# failure mode is not a process death but a wedged device
+# (`serve.stall_step`: the engine stops making retire-poll progress,
+# the EngineWatchdog's prey) or corrupted decode output
+# (`serve.poison_logits`: garbage token ids the retire poll's validity
+# guard must catch).  scripts/serve_chaos_probe.py iterates them.
+SERVE_POINTS = ("serve.stall_step", "serve.poison_logits",
+                "serve.kill_mid_drain")
+POINTS = CKPT_POINTS + HOST_POINTS + SERVE_POINTS  # all arm() accepts
 
 # Cross-process arming (the fleet probe's kill switch): the LAUNCHER
 # can't call arm() inside a child, so children read these env vars.
@@ -131,6 +141,24 @@ def check(point: str) -> None:
         _ARMED.pop(point, None)
         raise SimulatedPreemption(f"simulated preemption at {point}")
     _ARMED[point] = n - 1
+
+
+def fire(point: str) -> bool:
+    """Like `check()` but RETURNS True instead of raising — for fail
+    points whose effect is an injected corruption or stall rather than
+    a process death (the `serve.stall_step` / `serve.poison_logits`
+    points: the injection site flips its own behavior when the
+    countdown lands, and the failure is then DETECTED downstream by
+    the watchdog / validity guard under test).  Same countdown
+    semantics as `check()`; a no-op dict lookup when nothing is armed."""
+    n = _ARMED.get(point)
+    if n is None:
+        return False
+    if n <= 1:
+        _ARMED.pop(point, None)
+        return True
+    _ARMED[point] = n - 1
+    return False
 
 
 @contextlib.contextmanager

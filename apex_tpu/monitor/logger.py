@@ -95,7 +95,18 @@ from apex_tpu.monitor.sinks import MetricSink, ScalarWriter
 # `fleet_resume_ok` (bench's kill→resume cycle verdict).  All
 # OPTIONAL, never-null when present; `fleet_` joins the reserved
 # scalar prefixes.
-SCHEMA_VERSION = 9
+# v10 (ISSUE 14): the serving-resilience fields — terminal-state
+# lifetime counters stamped by `MetricsLogger(serve=engine)` whenever
+# telemetry is attached (`serve_shed_total` / `serve_expired_total` /
+# `serve_cancelled_total` — 0 is a real count for a healthy engine),
+# watchdog counters stamped once an `EngineWatchdog` is attached
+# (`serve_watchdog_stalls` / `serve_watchdog_restarts`), and bench's
+# overload-leg stamps (`serve_shed_fraction` — shed+expired fraction
+# of submissions under the 4× storm; `serve_goodput_tokens_per_sec` —
+# tokens of requests that completed OK per second, the number overload
+# control exists to protect).  All OPTIONAL, never-null when present;
+# same reserved `serve_` scalar prefix.
+SCHEMA_VERSION = 10
 
 # field -> (python type, finite_required).  loss_scale may legitimately
 # be large but is finite; grad/update norms are inf/nan ON overflow
@@ -188,6 +199,17 @@ OPTIONAL_SCHEMA = {
     "moe_z_loss": (float, False),
     "moe_drop_fraction": (float, False),
     "moe_gate_entropy": (float, False),
+    # v10 (ISSUE 14): serving resilience.  Terminal counters stamp
+    # with the rest of the live serve plane; watchdog counters only
+    # when an EngineWatchdog is attached; shed fraction / goodput are
+    # bench's overload-leg stamps — never null.
+    "serve_shed_total": (int, False),
+    "serve_expired_total": (int, False),
+    "serve_cancelled_total": (int, False),
+    "serve_watchdog_stalls": (int, False),
+    "serve_watchdog_restarts": (int, False),
+    "serve_shed_fraction": (float, False),
+    "serve_goodput_tokens_per_sec": (float, False),
 }
 _OPTIONAL_PREFIXES = ("compile_", "hbm_", "comms_", "serve_", "ckpt_",
                       "fleet_", "moe_")

@@ -23,6 +23,15 @@ Three layers, bottom-up:
     queue/pool gauges, and the `ServeSLO` verdict that
     `scripts/slo_probe.py` gates in CI.
 
+  * serve/watchdog.py + the engine's resilience plane (ISSUE 14):
+    per-request deadlines/TTL, cancellation, a bounded admission
+    queue with shed policies and SLO-driven proactive shedding,
+    terminal ledger states (`expired`/`cancelled`/`shed`), the
+    `EngineWatchdog` stall detector with bitwise snapshot restart,
+    and `drain()` for deploys — chaos-gated by
+    `scripts/serve_chaos_probe.py` over the `SERVE_POINTS` fail
+    points (checkpoint/chaos.py).
+
 docs/serving.md is the operator guide; examples/serve_gpt.py the
 runnable entry point; bench.py stamps `serve_*` decode-throughput and
 latency axes; docs/observability.md § "Reading the serving plane"
@@ -34,22 +43,27 @@ from apex_tpu.ops.flash_decode import (  # noqa: F401
     paged_attention_reference,
 )
 from apex_tpu.serve.engine import (  # noqa: F401
+    SHED_POLICIES,
     DecodeEngine,
     DecodeState,
     FinishedRequest,
+    PoisonedOutputError,
     ServeConfig,
     build_flagship_engine,
+    choose_shed_victim,
     measure_decode,
 )
 from apex_tpu.serve.kv_cache import (  # noqa: F401
     TRASH_PAGE,
     KVCacheConfig,
+    PageAccountingError,
     PagedKVCache,
     default_page_size,
     gather_slot,
 )
 from apex_tpu.serve.telemetry import (  # noqa: F401
     SERVE_TELEMETRY_VERSION,
+    TERMINAL_STATES,
     RequestLedger,
     RequestRecord,
     ServeSLO,
@@ -59,4 +73,8 @@ from apex_tpu.serve.telemetry import (  # noqa: F401
     StreamingPercentiles,
     step_latency_percentiles,
     validate_serve_report,
+)
+from apex_tpu.serve.watchdog import (  # noqa: F401
+    EngineStalledError,
+    EngineWatchdog,
 )

@@ -34,6 +34,34 @@ between steps) and the output rows of slots that finished — both
 outside the jitted step, both O(n_slots), both independent of
 sequence length.
 
+Failure semantics (ISSUE 14 — docs/serving.md "Overload & failure
+semantics" is the operator story):
+
+  * per-request DEADLINES — `submit(deadline_ms=)`; expired requests
+    are evicted from the queue at admit time and from live slots at
+    the retire poll (pages released, ledger terminal `expired`), so a
+    stuck client never strands pool pages;
+  * CANCELLATION — `cancel(rid)` removes a queued request outright and
+    ends a mid-generation one through the existing `done` mask (a
+    host-side value edit: no new compiled shapes, the RecompileSentry
+    stays green);
+  * OVERLOAD CONTROL — a bounded admission queue
+    (`ServeConfig.max_queue_depth`) with a shed policy (`shed-newest`
+    / `shed-lowest-deadline`), plus an SLO-driven proactive shed: with
+    a `ServeSLO(max_queue_wait_ms=)` attached the engine sheds when
+    the PROJECTED queue wait of a new arrival would breach — before
+    the queue-wait plane breaches, not after.  Backpressure surfaces
+    through `submit()` (`last_shed_rid`), the `overloaded` property,
+    and `gauges()['queue_saturation']`;
+  * WATCHDOG + DRAIN — `serve.watchdog.EngineWatchdog` detects a
+    stalled decode loop (no retire-poll progress within a timeout) and
+    restarts from `state_dict()` with bitwise mid-generation resume;
+    `drain()` stops admission, finishes live slots, and returns a
+    restorable snapshot for deploys.  The retire poll validates
+    retiring token ids (`PoisonedOutputError` on garbage — the
+    `serve.poison_logits` chaos point makes it reachable), and
+    `scripts/serve_chaos_probe.py` is the standing kill/overload gate.
+
 Model: the engine decodes `apex_tpu.models.gpt.GPT` weight pytrees
 (the flagship LM) on a single device — the forward here mirrors
 GPT._block op-for-op (same LayerNorm, same packed-QKV split order as
@@ -57,6 +85,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from apex_tpu.checkpoint import chaos as _chaos
 from apex_tpu.ops.flash_decode import flash_decode
 from apex_tpu.ops.layer_norm import fused_layer_norm
 from apex_tpu.serve.kv_cache import (TRASH_PAGE, KVCacheConfig,
@@ -72,17 +101,42 @@ _NEG_INF = -1e30
 # otherwise never leave warmup and the recompile gate would fail OPEN
 _STEADY_WARMUP_CAP = 6
 
+# admission/shed policies for the bounded queue (ISSUE 14)
+SHED_POLICIES = ("shed-newest", "shed-lowest-deadline")
+
+
+class PoisonedOutputError(RuntimeError):
+    """The retire poll fetched token ids outside [0, vocab) for a
+    finishing slot — the decode plane emitted garbage (a poisoned
+    logits path; the `serve.poison_logits` chaos point injects it).
+    Recovery is a restart from the last good snapshot (the
+    EngineWatchdog's contract)."""
+
+    def __init__(self, msg: str, slot: Optional[int] = None,
+                 request_id: Optional[int] = None,
+                 step: Optional[int] = None):
+        super().__init__(msg)
+        self.slot = slot
+        self.request_id = request_id
+        self.step = step
+
 
 @dataclasses.dataclass(frozen=True)
 class ServeConfig:
-    """Static serving-side knobs (everything here bakes into the
-    compiled step — change one and you have a NEW deployment, which
-    is the point: nothing a request carries can retrace the step).
+    """Static serving-side knobs.  The shape-bearing fields bake into
+    the compiled step — change one and you have a NEW deployment,
+    which is the point: nothing a request carries can retrace the
+    step.  The overload-control fields (`max_queue_depth`,
+    `shed_policy`) are HOST scheduler policy only — they never touch
+    a compiled shape and are deliberately absent from the deployment
+    fingerprint (a snapshot restores across a policy change).
 
     n_pages None sizes the pool so `pool_fraction` of the worst case
     (every slot at max_prompt_len + max_new_cap) fits — the paged
     saving shows up as pool_fraction < 1.  eos_id None disables EOS
-    termination (requests run to their max_new_tokens)."""
+    termination (requests run to their max_new_tokens).
+    max_queue_depth None keeps the legacy unbounded queue; a bound
+    arms the shed path (docs/serving.md, ISSUE 14)."""
 
     n_slots: int = 64
     max_prompt_len: int = 128
@@ -93,19 +147,43 @@ class ServeConfig:
     pool_fraction: float = 0.5
     cache_dtype: Any = None          # None → the model compute dtype
     emit_logits: bool = False        # decode also returns (slots, V) logits
+    max_queue_depth: Optional[int] = None
+    shed_policy: str = "shed-newest"
 
 
 @dataclasses.dataclass
 class FinishedRequest:
-    """One retired request: the host-side result `poll()` hands back."""
+    """One ended request: the host-side result `poll()` hands back.
+    `status` is the terminal state (serve/telemetry.py): "ok" carries
+    the full generation; "expired"/"cancelled" carry the partial
+    tokens generated before eviction (informational — the client
+    already stopped caring); "shed" carries none."""
 
     request_id: int
     prompt: List[int]
     tokens: List[int]                # generated ids (greedy), EOS included
     n_prompt: int = 0
+    status: str = "ok"
 
     def __post_init__(self):
         self.n_prompt = len(self.prompt)
+
+
+@dataclasses.dataclass
+class _Request:
+    """Host scheduler bookkeeping for one queued or live request.
+    `deadline_t`/`submit_t` are perf_counter-absolute; the snapshot
+    serializes them as AGES so they survive a cross-process restore."""
+
+    rid: int
+    prompt: List[int]
+    max_new: int
+    submit_t: float
+    deadline_t: Optional[float] = None
+    deadline_ms: Optional[float] = None
+
+    def expired(self, now: float) -> bool:
+        return self.deadline_t is not None and now >= self.deadline_t
 
 
 class DecodeState(NamedTuple):
@@ -136,6 +214,29 @@ class _Step:
 
     def __call__(self, *args):
         return self.jitted(*args)
+
+
+def choose_shed_victim(candidates, policy: str):
+    """The ONE shed-policy spelling (serve_chaos_probe's selftest
+    replays it engine-free).  `candidates` are queued requests in FIFO
+    order with the INCOMING request last; each carries `.rid` and
+    `.deadline_t` (None = no deadline).  Returns the victim:
+
+    * `shed-newest` — the incoming request: the queue's FIFO promise
+      to earlier arrivals holds, the late arrival absorbs the overload;
+    * `shed-lowest-deadline` — the EARLIEST-deadline candidate: it has
+      the least slack and is the likeliest to expire in the queue
+      anyway, so shedding it wastes the least feasible work.
+      Deadline-less requests (infinite slack) are shed last; ties
+      break toward the newest (highest rid) — the FIFO tilt again."""
+    if policy == "shed-newest":
+        return candidates[-1]
+    if policy != "shed-lowest-deadline":
+        raise ValueError(f"unknown shed policy {policy!r}; choices: "
+                         f"{SHED_POLICIES}")
+    return min(candidates,
+               key=lambda r: (r.deadline_t if r.deadline_t is not None
+                              else math.inf, -r.rid))
 
 
 def _dot(x, w, b=None):
@@ -169,6 +270,13 @@ class DecodeEngine:
             raise ValueError(
                 f"num_heads={c.num_heads} must divide hidden={c.hidden} "
                 "(head_dim = hidden // num_heads)")
+        if s.shed_policy not in SHED_POLICIES:
+            raise ValueError(
+                f"shed_policy {s.shed_policy!r} not in {SHED_POLICIES}")
+        if s.max_queue_depth is not None and s.max_queue_depth < 1:
+            raise ValueError(
+                f"max_queue_depth must be >= 1 (or None for unbounded), "
+                f"got {s.max_queue_depth}")
         self.model_cfg = c
         self.serve_cfg = s
         self.params = params
@@ -210,6 +318,10 @@ class DecodeEngine:
             ("params", "kv_cache", "state", "slot", "tokens", "length",
              "req_max_new"), (1, 2))
         from apex_tpu.monitor.compile import RecompileSentry
+        # retained so a watchdog restart can rebuild the replacement
+        # engine with the SAME flight recorder (post-incident
+        # observability must survive the incident)
+        self.recorder = recorder
         self.sentry = RecompileSentry(self.decode_step,
                                       name="serve_decode",
                                       recorder=recorder, warn=True)
@@ -217,10 +329,19 @@ class DecodeEngine:
         self.last_logits = None
 
         self._next_rid = 0
-        self._pending = collections.deque()    # (rid, prompt, max_new)
+        self._pending = collections.deque()    # _Request, FIFO
         self._free_slots = list(range(ns - 1, -1, -1))
-        self._live: Dict[int, tuple] = {}      # slot -> (rid, prompt)
+        self._live: Dict[int, _Request] = {}   # slot -> _Request
         self._finished: List[FinishedRequest] = []
+        # resilience plane (ISSUE 14)
+        self._draining = False
+        self._stalled = False
+        self._evict_status: Dict[int, str] = {}   # slot -> "cancelled"
+        self.steps_completed = 0     # retire-poll progress counter (the
+        #                              EngineWatchdog's heartbeat: a
+        #                              stalled step never bumps it)
+        self.last_shed_rid: Optional[int] = None  # per-submit signal
+        self.watchdog = None         # set by EngineWatchdog.__init__
 
         # serving observatory (ISSUE 10): the request-lifecycle ledger
         # + gauges.  Pure host bookkeeping — the compiled decode step
@@ -426,9 +547,110 @@ class DecodeEngine:
     def recompile_ok(self) -> bool:
         return self.sentry.steady_recompiles == 0
 
-    def submit(self, prompt: Sequence[int],
-               max_new_tokens: int) -> int:
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    @property
+    def stalled(self) -> bool:
+        return self._stalled
+
+    # ------------------------------------------------------------------
+    # overload control (ISSUE 14)
+    # ------------------------------------------------------------------
+
+    def projected_queue_wait_s(self) -> Optional[float]:
+        """The queue wait a NEWLY queued request is projected to see:
+        queue_depth × mean per-request service time / n_slots (the
+        M/M/c head approximation over the ledger's admit→retire
+        `service_s` estimator).  None until a request has retired —
+        the projection never guesses without data."""
+        if self.telemetry is None:
+            return None
+        svc = self.telemetry.ledger.service.mean
+        if svc is None:
+            return None
+        return len(self._pending) * svc / max(1, self.serve_cfg.n_slots)
+
+    @property
+    def overloaded(self) -> bool:
+        """The backpressure signal: True when the bounded queue is at
+        capacity, or when the SLO projection says a new arrival's
+        queue wait would breach `slo.max_queue_wait_ms` — the
+        shed-BEFORE-the-breach discipline.  Callers that can defer
+        work check this before `submit()`."""
         s = self.serve_cfg
+        if (s.max_queue_depth is not None
+                and len(self._pending) >= s.max_queue_depth):
+            return True
+        if self.slo is not None and self.slo.max_queue_wait_ms is not None:
+            proj = self.projected_queue_wait_s()
+            if proj is not None and 1e3 * proj > self.slo.max_queue_wait_ms:
+                return True
+        return False
+
+    def _shed_victim(self, incoming: _Request) -> _Request:
+        """Pick the request overload control sheds
+        (`choose_shed_victim` is the one policy spelling — the chaos
+        probe's selftest replays it engine-free).  The victim is
+        removed from the queue here when it is a queued one."""
+        victim = choose_shed_victim(list(self._pending) + [incoming],
+                                    self.serve_cfg.shed_policy)
+        if victim is not incoming:
+            self._pending.remove(victim)
+        return victim
+
+    def _shed(self, req: _Request, now: float) -> None:
+        if self.telemetry is not None:
+            self.telemetry.ledger.on_shed(req.rid, now)
+        self._finished.append(FinishedRequest(
+            request_id=req.rid, prompt=req.prompt, tokens=[],
+            status="shed"))
+        self.last_shed_rid = req.rid
+
+    def _expire_queued(self, req: _Request, now: float) -> None:
+        if self.telemetry is not None:
+            self.telemetry.ledger.on_expire(req.rid, now, n_tokens=0,
+                                            where="queue")
+        self._finished.append(FinishedRequest(
+            request_id=req.rid, prompt=req.prompt, tokens=[],
+            status="expired"))
+
+    def _sweep_expired_queue(self, now: float) -> int:
+        """Evict every queued request whose deadline has passed (the
+        admit-time half of the TTL contract — no pages were ever
+        reserved for these, so eviction is pure host bookkeeping)."""
+        if not any(r.deadline_t is not None for r in self._pending):
+            return 0
+        keep, dropped = [], 0
+        for req in self._pending:
+            if req.expired(now):
+                self._expire_queued(req, now)
+                dropped += 1
+            else:
+                keep.append(req)
+        if dropped:
+            self._pending = collections.deque(keep)
+        return dropped
+
+    def submit(self, prompt: Sequence[int], max_new_tokens: int,
+               deadline_ms: Optional[float] = None) -> int:
+        """Queue a request; returns its request id.  `deadline_ms` is
+        a TTL from NOW: a request still queued past it is evicted at
+        the admit sweep, a live one at the retire poll (terminal state
+        `expired`, pages released either way).
+
+        Backpressure: with a bounded queue (`max_queue_depth`) at
+        capacity — or an attached SLO whose queue-wait projection says
+        a new arrival would breach — the shed policy picks a victim
+        (possibly this request).  The victim ends `shed`: it surfaces
+        through `poll()` with that status, and `last_shed_rid` is set
+        for the duration of this call (None when nothing was shed) so
+        the submitter sees the signal synchronously."""
+        s = self.serve_cfg
+        if self._draining:
+            raise RuntimeError("submit() during drain(): admission is "
+                               "stopped — this engine is shutting down")
         prompt = [int(t) for t in prompt]
         if not prompt:
             raise ValueError("empty prompt")
@@ -440,6 +662,9 @@ class DecodeEngine:
             raise ValueError(
                 f"max_new_tokens {max_new_tokens} not in "
                 f"[1, {s.max_new_cap}]")
+        if deadline_ms is not None and deadline_ms <= 0:
+            raise ValueError(
+                f"deadline_ms must be > 0 (or None), got {deadline_ms}")
         # reject requests NO future state can admit (an explicit small
         # n_pages can undercut the per-slot worst case) — queueing one
         # would spin the engine forever behind a head-of-line request
@@ -453,45 +678,97 @@ class DecodeEngine:
                 f"max_new {max_new_tokens} at page_size "
                 f"{self.kv_config.page_size}) but this deployment can "
                 f"ever serve at most {ceiling} per request")
+        now = time.perf_counter()
         rid = self._next_rid
         self._next_rid += 1
-        self._pending.append((rid, prompt, int(max_new_tokens)))
+        req = _Request(
+            rid=rid, prompt=prompt, max_new=int(max_new_tokens),
+            submit_t=now,
+            deadline_t=(now + deadline_ms / 1e3
+                        if deadline_ms is not None else None),
+            deadline_ms=deadline_ms)
         if self.telemetry is not None:
-            self.telemetry.ledger.on_submit(rid, len(prompt),
-                                            int(max_new_tokens),
-                                            time.perf_counter())
+            self.telemetry.ledger.on_submit(
+                rid, len(prompt), int(max_new_tokens), now,
+                deadline_ms=deadline_ms)
+        self.last_shed_rid = None
+        # expired queue entries are dead weight — drop them BEFORE
+        # judging capacity, so a full-of-corpses queue doesn't shed a
+        # viable request
+        self._sweep_expired_queue(now)
+        if self.overloaded:
+            victim = self._shed_victim(req)
+            self._shed(victim, now)
+            if victim is req:
+                return rid
+        self._pending.append(req)
         return rid
+
+    def cancel(self, request_id: int) -> bool:
+        """Cancel a request by id.  In-queue: removed outright
+        (terminal `cancelled`, surfaced through `poll()`).
+        Mid-generation: the slot's `done` flag is set host-side — a
+        VALUE edit on the existing mask, so the compiled step never
+        changes — and the next retire poll retires it with the tokens
+        generated so far, releasing its pages.  Returns True when the
+        request was found live or queued; False for an unknown or
+        already-terminal id (cancelling twice is a no-op, not an
+        error).  A cancel that races natural completion still reports
+        `cancelled` — the client had already stopped listening."""
+        for req in self._pending:
+            if req.rid == request_id:
+                self._pending.remove(req)
+                if self.telemetry is not None:
+                    self.telemetry.ledger.on_cancel(
+                        request_id, time.perf_counter(), n_tokens=0,
+                        where="queue")
+                self._finished.append(FinishedRequest(
+                    request_id=request_id, prompt=req.prompt, tokens=[],
+                    status="cancelled"))
+                return True
+        for slot, req in self._live.items():
+            if req.rid == request_id:
+                if self._evict_status.get(slot) == "cancelled":
+                    return False           # already cancelled, in flight
+                self._evict_status[slot] = "cancelled"
+                self.state = self.state._replace(
+                    done=self.state.done.at[slot].set(True))
+                return True
+        return False
 
     def _try_admit(self) -> int:
         """Admit queued requests into free slots while pages last.
         FIFO head-of-line: a request that doesn't fit blocks the queue
-        (no starvation of big requests)."""
+        (no starvation of big requests).  Deadline-expired entries are
+        swept first — the admit-time half of the TTL contract."""
         admitted = 0
+        self._sweep_expired_queue(time.perf_counter())
         while self._pending and self._free_slots:
-            rid, prompt, max_new = self._pending[0]
+            req = self._pending[0]
             slot = self._free_slots[-1]
-            row = self.cache.allocate_slot(slot, len(prompt) + max_new)
+            row = self.cache.allocate_slot(
+                slot, len(req.prompt) + req.max_new)
             if row is None:
                 break                      # pool exhausted — retry later
             self._pending.popleft()
             self._free_slots.pop()
-            self._live[slot] = (rid, prompt)
+            self._live[slot] = req
             # admit stamp = the scheduler's decision moment, BEFORE
             # the prefill dispatch: queue wait measures time in the
             # queue, not the admitting prefill's (possibly compiling)
             # dispatch — that cost lands in TTFT, where it belongs
             if self.telemetry is not None:
-                self.telemetry.ledger.on_admit(rid, slot,
+                self.telemetry.ledger.on_admit(req.rid, slot,
                                                time.perf_counter())
-                self._awaiting_first.append(rid)
+                self._awaiting_first.append(req.rid)
             self.state = self.state._replace(
                 block_table=self.cache.device_table())
             padded = np.zeros((self.serve_cfg.max_prompt_len,), np.int32)
-            padded[:len(prompt)] = prompt
+            padded[:len(req.prompt)] = req.prompt
             self.kv, self.state = self._prefill(
                 self.params, self.kv, self.state, np.int32(slot),
-                jnp.asarray(padded), np.int32(len(prompt)),
-                np.int32(max_new))
+                jnp.asarray(padded), np.int32(len(req.prompt)),
+                np.int32(req.max_new))
             admitted += 1
         return admitted
 
@@ -499,7 +776,14 @@ class DecodeEngine:
         """The scheduler's ONLY steady-state device reads: the done
         flags and generated counts (two (n_slots,) fetches), plus the
         output rows of slots that actually finished.  Returns the
-        number of requests retired."""
+        number of slots vacated — normal retirements PLUS deadline
+        evictions and cancellations, all of which exit here (one poll,
+        one page-release path: the pool can only reconcile one way).
+
+        Before any slot is mutated, finishing tokens are validated
+        against the vocab — garbage ids raise `PoisonedOutputError`
+        naming the slot/request/step with the engine untouched, so a
+        watchdog restart recovers from the last good snapshot."""
         if not self._live:
             return 0
         done = np.asarray(self.state.done)
@@ -508,54 +792,97 @@ class DecodeEngine:
         # prefills and their decode included) has materialized — so
         # the host clock NOW bounds the device-side truth, and the
         # lifecycle stamps below cost no extra sync (ISSUE 10).
+        now = time.perf_counter()
         if self.telemetry is not None:
-            now = time.perf_counter()
             if self._awaiting_first:
                 self.telemetry.ledger.on_first_token(
                     self._awaiting_first, now)
                 self._awaiting_first = []
-        if not done.any():
+        # the retire-poll half of the TTL contract: live slots whose
+        # deadline passed are evicted NOW — their pages go back to the
+        # pool instead of decoding for a client that stopped waiting
+        expired = [s for s, req in self._live.items()
+                   if not done[s] and req.expired(now)]
+        if not done.any() and not expired:
             return 0
         n_gen = np.asarray(self.state.n_generated)
         # one wholesale fetch for the wave — per-slot slicing would
         # cost a device round-trip per finished request
         out_tok = np.asarray(self.state.out_tokens)
-        to_clear = []
-        for slot in sorted(self._live):
-            if not done[slot]:
-                continue
-            rid, prompt = self._live.pop(slot)
+        leaving = [s for s in sorted(self._live)
+                   if done[s] or s in expired]
+        # poison guard FIRST, before any bookkeeping mutates: all-or-
+        # nothing, the restart path needs a consistent engine to dump.
+        # EVERY leaving slot is validated — an expired eviction still
+        # delivers its partial tokens, and a corrupted decode plane
+        # whose victims all expire (mass client timeout) must trip the
+        # guard, not keep serving
+        vocab = self.model_cfg.vocab_size
+        for slot in leaving:
+            toks = out_tok[slot, :int(n_gen[slot])]
+            if toks.size and (int(toks.min()) < 0
+                              or int(toks.max()) >= vocab):
+                rid = self._live[slot].rid
+                raise PoisonedOutputError(
+                    f"slot {slot} (request {rid}) finished with token "
+                    f"ids outside [0, {vocab}) at step "
+                    f"{self.steps_completed} — the decode plane "
+                    "emitted garbage; restart from the last good "
+                    "snapshot", slot=slot, request_id=rid,
+                    step=self.steps_completed)
+        for slot in leaving:
+            req = self._live.pop(slot)
             n = int(n_gen[slot])
             toks = out_tok[slot, :n].tolist()
+            if done[slot]:
+                status = self._evict_status.pop(slot, "ok")
+            else:
+                status = "expired"
+                self._evict_status.pop(slot, None)
             self._finished.append(
-                FinishedRequest(request_id=rid, prompt=prompt,
-                                tokens=toks))
+                FinishedRequest(request_id=req.rid, prompt=req.prompt,
+                                tokens=toks, status=status))
             if self.telemetry is not None:
-                self.telemetry.ledger.on_retire(rid, n, now)
+                led = self.telemetry.ledger
+                if status == "ok":
+                    led.on_retire(req.rid, n, now)
+                elif status == "cancelled":
+                    led.on_cancel(req.rid, now, n_tokens=n, where="live")
+                else:
+                    led.on_expire(req.rid, now, n_tokens=n, where="live")
             self.cache.release_slot(slot)
             self._free_slots.append(slot)
-            to_clear.append(slot)
-        if to_clear:
-            idx = jnp.asarray(to_clear, jnp.int32)
-            self.state = self.state._replace(
-                lengths=self.state.lengths.at[idx].set(0),
-                n_generated=self.state.n_generated.at[idx].set(0),
-                done=self.state.done.at[idx].set(False))
-        return len(to_clear)
+        idx = jnp.asarray(leaving, jnp.int32)
+        self.state = self.state._replace(
+            lengths=self.state.lengths.at[idx].set(0),
+            n_generated=self.state.n_generated.at[idx].set(0),
+            done=self.state.done.at[idx].set(False))
+        return len(leaving)
 
     def step(self):
         """One engine iteration: retire → admit → decode-all-slots.
         Returns (admitted, retired) counts so callers can tell churn
         steps (which carry prefill/cleanup work) from pure decode
         steps — the bench's steady-state latency percentiles exclude
-        the former."""
+        the former.  `retired` counts every vacated slot: normal
+        completions plus deadline evictions and cancellations (the
+        ledger splits them by terminal state).
+
+        A step that made retire-poll progress bumps `steps_completed`
+        — the EngineWatchdog's heartbeat.  The `serve.stall_step`
+        chaos point wedges the engine (no poll, no progress, forever —
+        a hung device, not a crash); the watchdog is what notices."""
+        if self._stalled or _chaos.fire("serve.stall_step"):
+            self._stalled = True
+            return 0, 0
         retired = self._retire_finished()
-        admitted = self._try_admit()
+        admitted = 0 if self._draining else self._try_admit()
         if not self._live:
             # fully drained (a non-empty queue always admits into an
             # empty grid — submit() rejected anything that can't):
             # skip the all-inactive decode forward the final retire
             # would otherwise pay for nothing
+            self.steps_completed += 1
             if self.telemetry is not None:
                 self.telemetry.note_step(admitted, retired, self.gauges())
             return admitted, retired
@@ -564,6 +891,14 @@ class DecodeEngine:
             self.kv, self.state, self.last_logits = out
         else:
             self.kv, self.state = out
+        if _chaos.fire("serve.poison_logits"):
+            # inject the corruption the retire poll's validity guard
+            # exists for: every live slot's output ring turns to
+            # garbage ids, detected (by name) when one finishes
+            live = jnp.asarray(sorted(self._live), jnp.int32)
+            self.state = self.state._replace(
+                out_tokens=self.state.out_tokens.at[live].set(-1))
+        self.steps_completed += 1
         # first call that did NOT compile = warmup over; from here any
         # retrace is a steady-state recompile (the correctness gate).
         # The warmup cap closes the fail-open hole: a step retracing
@@ -600,6 +935,33 @@ class DecodeEngine:
         out, self._finished = self._finished, []
         return out
 
+    def drain(self, max_steps: int = 10_000) -> dict:
+        """Graceful shutdown for deploys: STOP admission, run the live
+        slots to completion (deadlines and cancellations still apply),
+        and return a restorable `state_dict()` snapshot — the
+        still-queued requests ride in it, so the replacement engine
+        (same deployment, new weights rolled back, new host...) picks
+        them up with `load_state_dict` and nothing a client submitted
+        is lost.  Finished results remain available via `poll()`.
+
+        The `serve.kill_mid_drain` chaos point kills the loop partway
+        (a deploy's own preemption); the PR 9 snapshot contract is the
+        recovery — `scripts/serve_chaos_probe.py` drives the matrix."""
+        self._draining = True
+        try:
+            steps = 0
+            while self._live:
+                _chaos.check("serve.kill_mid_drain")
+                if steps >= max_steps:
+                    raise RuntimeError(
+                        f"drain(): {len(self._live)} slot(s) still live "
+                        f"after {max_steps} steps")
+                self.step()
+                steps += 1
+            return self.state_dict()
+        finally:
+            self._draining = False
+
     def stats(self) -> dict:
         return {
             "n_slots": self.serve_cfg.n_slots,
@@ -609,6 +971,9 @@ class DecodeEngine:
             "pool_bytes": self.kv_config.pool_bytes(),
             "recompile_ok": self.recompile_ok,
             "sentry": self.sentry.summary(),
+            "draining": self._draining,
+            "stalled": self._stalled,
+            "steps_completed": self.steps_completed,
         }
 
     # ------------------------------------------------------------------
@@ -620,6 +985,7 @@ class DecodeEngine:
         the scheduler already owns, zero device traffic."""
         cfg = self.kv_config
         used = cfg.usable_pages - self.cache.free_pages
+        mqd = self.serve_cfg.max_queue_depth
         return {
             "slots_live": len(self._live),
             "slots_free": len(self._free_slots),
@@ -627,6 +993,11 @@ class DecodeEngine:
             "pages_free": self.cache.free_pages,
             "pages_used": used,
             "pool_util": used / max(1, cfg.usable_pages),
+            # the backpressure gauge (ISSUE 14): how full the bounded
+            # admission queue is; 0.0 under the legacy unbounded queue
+            # (there is no capacity to saturate)
+            "queue_saturation": (len(self._pending) / mqd
+                                 if mqd else 0.0),
         }
 
     def serve_record(self) -> dict:
@@ -636,6 +1007,9 @@ class DecodeEngine:
         if self.telemetry is None:
             return {}
         rec = self.telemetry.serve_record()
+        if self.watchdog is not None:
+            rec["serve_watchdog_stalls"] = int(self.watchdog.stalls)
+            rec["serve_watchdog_restarts"] = int(self.watchdog.restarts)
         if self.slo is not None:
             v = self.slo_verdict()
             # only GROUNDED verdicts stamp: a breach always does; a
@@ -675,7 +1049,13 @@ class DecodeEngine:
     # checkpoint / preemption resume (ISSUE 9)
     # ------------------------------------------------------------------
 
-    _SERVE_STATE_VERSION = 1
+    # v2 (ISSUE 14): scheduler entries carry submit AGE and REMAINING
+    # deadline (perf_counter absolutes are process-relative, so the
+    # snapshot stores deltas and load re-absolutizes them) plus the
+    # finished list's terminal statuses — restored in-flight requests
+    # keep their original submit stamps and a deadline keeps counting
+    # down across the restore.  v1 snapshots are refused by version.
+    _SERVE_STATE_VERSION = 2
 
     def _deployment_fingerprint(self) -> dict:
         """The static knobs that bake into the compiled step — a
@@ -705,6 +1085,20 @@ class DecodeEngine:
         continues bitwise where it left off (tests/test_checkpoint.py
         pins the resumed tokens to the unpreempted run's)."""
         jax.block_until_ready((self.kv, self.state))
+        snap_t = time.perf_counter()
+
+        def pack(req: _Request) -> list:
+            # submit age + remaining deadline: deltas survive the
+            # process boundary that perf_counter absolutes do not.  A
+            # remaining deadline may be NEGATIVE (already expired at
+            # snapshot time) — preserved, so it expires immediately on
+            # resume instead of being granted a fresh TTL.
+            return [req.rid, list(req.prompt), req.max_new,
+                    snap_t - req.submit_t,
+                    (req.deadline_t - snap_t
+                     if req.deadline_t is not None else None),
+                    req.deadline_ms]
+
         return {
             "serve_state_version": self._SERVE_STATE_VERSION,
             "deployment": self._deployment_fingerprint(),
@@ -714,13 +1108,15 @@ class DecodeEngine:
             "cache": self.cache.state_dict(),
             "scheduler": {
                 "next_rid": self._next_rid,
-                "pending": [[rid, list(p), mn]
-                            for rid, p, mn in self._pending],
+                "pending": [pack(r) for r in self._pending],
                 "free_slots": list(self._free_slots),
-                "live": {int(s): [rid, list(p)]
-                         for s, (rid, p) in self._live.items()},
+                "live": {int(s): pack(r)
+                         for s, r in self._live.items()},
+                "evict_status": {int(s): st for s, st
+                                 in self._evict_status.items()},
                 "finished": [[f.request_id, list(f.prompt),
-                              list(f.tokens)] for f in self._finished],
+                              list(f.tokens), f.status]
+                             for f in self._finished],
             },
         }
 
@@ -752,17 +1148,32 @@ class DecodeEngine:
         self.state = DecodeState(**ds)
         self.cache.load_state_dict(d["cache"])
         sch = d["scheduler"]
+        now = time.perf_counter()
+
+        def unpack(entry) -> _Request:
+            rid, p, mn, age, remaining, dl_ms = entry
+            return _Request(
+                rid=int(rid), prompt=[int(t) for t in p],
+                max_new=int(mn), submit_t=now - float(age),
+                deadline_t=(now + float(remaining)
+                            if remaining is not None else None),
+                deadline_ms=(float(dl_ms) if dl_ms is not None
+                             else None))
+
         self._next_rid = int(sch["next_rid"])
         self._pending = collections.deque(
-            (int(rid), [int(t) for t in p], int(mn))
-            for rid, p, mn in sch["pending"])
+            unpack(e) for e in sch["pending"])
         self._free_slots = [int(s) for s in sch["free_slots"]]
-        self._live = {int(s): (int(rid), [int(t) for t in p])
-                      for s, (rid, p) in sch["live"].items()}
+        self._live = {int(s): unpack(e) for s, e in sch["live"].items()}
+        self._evict_status = {int(s): str(st) for s, st
+                              in sch.get("evict_status", {}).items()}
         self._finished = [
             FinishedRequest(request_id=int(rid), prompt=[int(t) for t in p],
-                            tokens=[int(t) for t in toks])
-            for rid, p, toks in sch["finished"]]
+                            tokens=[int(t) for t in toks],
+                            status=str(status))
+            for rid, p, toks, status in sch["finished"]]
+        self._draining = False
+        self._stalled = False
         # the ledger is RESTORE-scoped (monotonic stamps die with the
         # process; it is deliberately not in the snapshot): the
         # telemetry is rebuilt FRESH — an in-place rollback on a
@@ -782,18 +1193,22 @@ class DecodeEngine:
                 tail_cap=old.ledger.tail.maxlen,
                 estimator_capacity=old.ledger.ttft.capacity,
                 step_time_warmup=old._step_time_warmup)
-            now = time.perf_counter()
             led = self.telemetry.ledger
-            for rid, p, mn in self._pending:
-                led.reopen_restored(rid, len(p), mn, now)
-            max_new = np.asarray(self.state.max_new)
-            for slot, (rid, p) in self._live.items():
-                led.reopen_restored(rid, len(p), int(max_new[slot]),
-                                    now, slot=slot)
+            for req in self._pending:
+                led.reopen_restored(req.rid, len(req.prompt),
+                                    req.max_new, now,
+                                    submit_t=req.submit_t,
+                                    deadline_ms=req.deadline_ms)
+            for slot, req in self._live.items():
+                led.reopen_restored(req.rid, len(req.prompt),
+                                    req.max_new, now, slot=slot,
+                                    submit_t=req.submit_t,
+                                    deadline_ms=req.deadline_ms)
 
 
 def measure_decode(eng: DecodeEngine, *, warm: int = 2,
-                   max_steps: Optional[int] = None) -> dict:
+                   max_steps: Optional[int] = None,
+                   stop=None) -> dict:
     """Drive a loaded engine to completion and measure it — the ONE
     timing convention bench.py's `serve_*` stamps and
     examples/serve_gpt.py both quote (two hand-rolled loops already
@@ -823,6 +1238,14 @@ def measure_decode(eng: DecodeEngine, *, warm: int = 2,
       ledger          the engine's ledger summary (None when the
                       engine was built telemetry=False)
       recompile_ok    the sentry verdict
+      stopped         True when `stop` ended the drive early
+
+    `stop=` (ISSUE 14) is a zero-arg callable polled BETWEEN steps
+    once at least one step has been measured; returning True ends the
+    drive with work still pending — the graceful-shutdown hook
+    (examples/serve_gpt.py's SIGTERM handler sets a flag this reads,
+    then hands the remainder to `drain()`).  The returned stats cover
+    the steps that actually ran.
 
     ISSUE 10 re-expressed the percentile math over the ledger's
     module: `telemetry.step_latency_percentiles` is the ONE
@@ -839,7 +1262,11 @@ def measure_decode(eng: DecodeEngine, *, warm: int = 2,
     finished: List[FinishedRequest] = []
     polled_tokens = 0
     n_admitted = n_retired = 0
+    stopped = False
     while eng.pending:
+        if stop is not None and per_step and stop():
+            stopped = True           # graceful early exit, between steps
+            break
         if max_steps is not None and len(per_step) >= max_steps:
             raise RuntimeError(
                 f"measure_decode: {eng.pending} request(s) still live "
@@ -893,12 +1320,23 @@ def measure_decode(eng: DecodeEngine, *, warm: int = 2,
         "ledger": (eng.telemetry.ledger.summary()
                    if eng.telemetry is not None else None),
         "recompile_ok": eng.recompile_ok,
+        "stopped": stopped,
     }
+
+
+def flagship_n_slots(on_tpu: bool) -> int:
+    """The flagship slot-count policy — 64 on TPU, 8 on the CPU smoke
+    backend.  Exposed so callers that need the default BEFORE building
+    (bench's overload leg sizes its queue bound off it) don't pay a
+    throwaway engine construction for one integer."""
+    return 64 if on_tpu else 8
 
 
 def build_flagship_engine(on_tpu: bool, n_slots: Optional[int] = None,
                           seed: int = 0, recorder=None,
-                          params=None) -> DecodeEngine:
+                          params=None,
+                          serve_overrides: Optional[dict] = None,
+                          ) -> DecodeEngine:
     """The ONE serving setup bench.py and the standing gates
     (scripts/lint_step.py serve, scripts/comms_probe.py serve) build —
     one copy, not a drift-prone re-spelling (the lint_step
@@ -916,11 +1354,17 @@ def build_flagship_engine(on_tpu: bool, n_slots: Optional[int] = None,
     seed-identical 350M init would otherwise be paid per level).
     `n_slots=None` takes the flagship default, 64 on TPU / 8 on the
     CPU smoke backend — the ONE place the policy lives (the lint and
-    comms gates must probe the same program bench measures)."""
+    comms gates must probe the same program bench measures).
+
+    `serve_overrides=` replaces ServeConfig fields on top of the
+    flagship defaults (bench's overload leg and the chaos probe bound
+    the queue this way: `{"max_queue_depth": 16, "shed_policy":
+    "shed-lowest-deadline"}`) — shape-bearing overrides make a new
+    deployment, scheduler-policy ones don't."""
     from apex_tpu.models.gpt import GPTConfig
 
     if n_slots is None:
-        n_slots = 64 if on_tpu else 8
+        n_slots = flagship_n_slots(on_tpu)
     if on_tpu:
         cfg = GPTConfig(vocab_size=50304, seq_len=1024, hidden=1024,
                         num_layers=24, num_heads=16, dropout=0.0,
@@ -932,6 +1376,8 @@ def build_flagship_engine(on_tpu: bool, n_slots: Optional[int] = None,
                         num_layers=2, num_heads=4, dropout=0.0)
         sc = ServeConfig(n_slots=n_slots, max_prompt_len=16,
                          max_new_cap=16, page_size=8)
+    if serve_overrides:
+        sc = dataclasses.replace(sc, **serve_overrides)
     if params is None:
         params = _init_gpt_params(cfg, seed)
     return DecodeEngine(cfg, params, sc, recorder=recorder)

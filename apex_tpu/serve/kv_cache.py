@@ -55,6 +55,16 @@ import numpy as np
 TRASH_PAGE = 0
 
 
+class PageAccountingError(ValueError):
+    """The free-list accounting was about to be corrupted: a release of
+    a slot that holds no pages (double release, or a slot that was
+    never allocated).  Raised BY NAME instead of silently extending the
+    free list — the resilience eviction paths (deadline expiry,
+    cancellation, watchdog restart; ISSUE 14) made the double-release
+    reachable for the first time, and a silent one would hand the same
+    page to two sequences later."""
+
+
 def default_page_size(n_kv_heads: int, head_dim: int, dtype=None) -> int:
     """Tuner-owned page size with a deterministic heuristic fallback.
 
@@ -222,10 +232,18 @@ class PagedKVCache:
     def release_slot(self, slot: int) -> None:
         """Return a retired slot's pages to the pool.  The table row
         keeps its (now stale) entries until reassignment — stale ids
-        are read-harmless by the position-masking contract."""
-        pages = self._slot_pages.pop(slot, None)
-        if pages:
-            self._free.extend(pages)
+        are read-harmless by the position-masking contract.
+
+        A release of a slot holding no pages raises
+        `PageAccountingError` BY NAME: it is either a double release or
+        a never-allocated slot, and silently ignoring it (the pre-
+        ISSUE-14 behavior) masks exactly the scheduler bug that later
+        double-allocates a page to two live sequences."""
+        if slot not in self._slot_pages:
+            raise PageAccountingError(
+                f"release_slot({slot}): slot holds no pages — double "
+                "release, or a slot that was never allocated")
+        self._free.extend(self._slot_pages.pop(slot))
 
     def slot_pages(self, slot: int) -> List[int]:
         return list(self._slot_pages.get(slot, ()))

@@ -66,7 +66,21 @@ import math
 import random
 from typing import Dict, List, Optional, Sequence
 
-SERVE_TELEMETRY_VERSION = 1
+# v2 (ISSUE 14): the ledger grew TERMINAL STATES — before, every
+# submitted request was assumed to retire normally; now a request ends
+# in exactly one of `ok` / `expired` (deadline passed in queue or in a
+# live slot) / `cancelled` (client abandoned, in queue or
+# mid-generation) / `shed` (overload control refused it), and the
+# lifetime counters balance EXACTLY: n_submitted == n_retired +
+# n_expired + n_cancelled + n_shed + n_open (`RequestLedger.balance()`
+# is the one spelling, probe- and test-enforced).  Records carry
+# `status` + `deadline_ms`, the summary carries the terminal counters
+# and the new `service_s` estimator (admit→retire span of OK requests
+# — what the engine's proactive-shed projection quotes).
+SERVE_TELEMETRY_VERSION = 2
+
+# a request's terminal states (RequestRecord.status; "open" until then)
+TERMINAL_STATES = ("ok", "expired", "cancelled", "shed")
 
 # reservoir size: exact percentiles for every CI-scale run (and any
 # sane bench sweep), ~32 KiB of floats at production churn
@@ -192,11 +206,19 @@ class RequestRecord:
     retire_t: Optional[float] = None
     n_tokens: int = 0
     slot: Optional[int] = None
-    # a request re-registered after a preemption resume: its stamps
-    # are resume-relative (the pre-preemption wall time is gone with
-    # the process), so it counts in the ledger's totals but never
-    # feeds the latency estimators
+    # a request re-registered after a preemption resume: its in-flight
+    # stamps are resume-relative, so it counts in the ledger's totals
+    # but never feeds the latency estimators.  (Since ISSUE 14 the
+    # SUBMIT stamp of a restored request IS its original one — the
+    # snapshot preserves submit age — only the admit/first-token
+    # re-stamps are resume artifacts.)
     restored: bool = False
+    # terminal state (ISSUE 14): "open" until the request ends, then
+    # exactly one of TERMINAL_STATES.  `where` records which side of
+    # the scheduler a non-ok terminal hit ("queue" | "live").
+    status: str = "open"
+    where: Optional[str] = None
+    deadline_ms: Optional[float] = None
 
     @property
     def queue_wait_s(self) -> Optional[float]:
@@ -244,6 +266,9 @@ class RequestRecord:
             "ttft_s": self.ttft_s,
             "per_token_s": self.per_token_s,
             "restored": self.restored,
+            "status": self.status,
+            "where": self.where,
+            "deadline_ms": self.deadline_ms,
         }
 
 
@@ -266,19 +291,31 @@ class RequestLedger:
         self.n_admitted = 0
         self.n_retired = 0
         self.tokens_emitted = 0
+        # terminal-state counters (ISSUE 14).  The `_queue`/`_live`
+        # split records WHERE a request died — the reconciliation
+        # teeth: queue-side terminals never touched a slot, live-side
+        # ones exited through the retire poll like a normal retire.
+        self.n_expired_queue = 0
+        self.n_expired_live = 0
+        self.n_cancelled_queue = 0
+        self.n_cancelled_live = 0
+        self.n_shed = 0
         # distinct seeds: identical sample streams into two estimators
         # must not share an eviction pattern
         self.queue_wait = StreamingPercentiles(estimator_capacity, seed=1)
         self.ttft = StreamingPercentiles(estimator_capacity, seed=2)
         self.token_lat = StreamingPercentiles(estimator_capacity, seed=3)
+        # admit→retire span of OK requests: the per-request service
+        # time the engine's proactive-shed projection quotes
+        self.service = StreamingPercentiles(estimator_capacity, seed=5)
 
     # ----------------------------- hooks -----------------------------
 
     def on_submit(self, request_id: int, n_prompt: int, max_new: int,
-                  t: float) -> None:
+                  t: float, deadline_ms: Optional[float] = None) -> None:
         self._open[request_id] = RequestRecord(
             request_id=request_id, n_prompt=n_prompt, max_new=max_new,
-            submit_t=t)
+            submit_t=t, deadline_ms=deadline_ms)
         self.n_submitted += 1
 
     def on_admit(self, request_id: int, slot: int, t: float) -> None:
@@ -307,6 +344,7 @@ class RequestLedger:
                              "that is not open")
         rec.retire_t = t
         rec.n_tokens = int(n_tokens)
+        rec.status = "ok"
         self.n_retired += 1
         self.tokens_emitted += rec.n_tokens
         if rec.restored:
@@ -319,19 +357,78 @@ class RequestLedger:
             self.ttft.add(rec.ttft_s)
         if rec.per_token_s is not None:
             self.token_lat.add(rec.per_token_s)
+        if rec.admit_t is not None:
+            self.service.add(t - rec.admit_t)
         self.tail.append(rec)
+
+    def _close_terminal(self, request_id: int, t: float, status: str,
+                        where: str, n_tokens: int) -> RequestRecord:
+        rec = self._open.pop(request_id, None)
+        if rec is None:
+            raise ValueError(
+                f"ledger: {status} of request {request_id} that is "
+                "not open")
+        rec.retire_t = t
+        rec.n_tokens = int(n_tokens)
+        rec.status = status
+        rec.where = where
+        # non-ok terminals count in the totals and ride the tail but
+        # NEVER feed the latency estimators: the SLO percentiles judge
+        # the latency of requests the engine actually served — a shed
+        # request's zero-length "service" or an expired request's
+        # deadline-capped wait would deflate/skew them, not measure
+        # them (tokens_emitted likewise counts only delivered output)
+        self.tail.append(rec)
+        return rec
+
+    def on_expire(self, request_id: int, t: float, n_tokens: int = 0,
+                  where: str = "queue") -> None:
+        """Terminal `expired`: the request's deadline passed — in the
+        queue (never admitted; evicted at the admit sweep) or in a
+        live slot (evicted at the retire poll, partial tokens noted
+        but not delivered)."""
+        self._close_terminal(request_id, t, "expired", where, n_tokens)
+        if where == "queue":
+            self.n_expired_queue += 1
+        else:
+            self.n_expired_live += 1
+
+    def on_cancel(self, request_id: int, t: float, n_tokens: int = 0,
+                  where: str = "queue") -> None:
+        """Terminal `cancelled`: the client abandoned the request —
+        removed from the queue, or retired mid-generation through the
+        `done` mask at the next retire poll."""
+        self._close_terminal(request_id, t, "cancelled", where, n_tokens)
+        if where == "queue":
+            self.n_cancelled_queue += 1
+        else:
+            self.n_cancelled_live += 1
+
+    def on_shed(self, request_id: int, t: float) -> None:
+        """Terminal `shed`: overload control refused the request at
+        admission (bounded queue full, or the SLO projection said a
+        new arrival would breach the queue-wait contract)."""
+        self._close_terminal(request_id, t, "shed", "queue", 0)
+        self.n_shed += 1
 
     def reopen_restored(self, request_id: int, n_prompt: int,
                         max_new: int, t: float,
-                        slot: Optional[int] = None) -> None:
+                        slot: Optional[int] = None,
+                        submit_t: Optional[float] = None,
+                        deadline_ms: Optional[float] = None) -> None:
         """Re-register a request restored from a preemption snapshot
-        (`DecodeEngine.load_state_dict`): queued requests re-enter as
-        fresh submissions (their queue wait from the restore point is
-        real); in-flight requests additionally stamp admit/first-token
-        at the restore moment and are marked `restored`, so they
-        reconcile in the counters without poisoning the latency
-        estimators with resume-relative deltas."""
-        self.on_submit(request_id, n_prompt, max_new, t)
+        (`DecodeEngine.load_state_dict`).  Since ISSUE 14 the snapshot
+        preserves each request's submit AGE, so restored requests keep
+        their ORIGINAL submit stamps (`submit_t=`, already
+        re-absolutized by the engine) — a restored queued request's
+        queue wait includes the time it already spent waiting before
+        the preemption.  In-flight requests additionally stamp
+        admit/first-token at the restore moment and are marked
+        `restored`, so they reconcile in the counters without feeding
+        resume-relative admit deltas into the latency estimators."""
+        self.on_submit(request_id, n_prompt, max_new,
+                       t if submit_t is None else submit_t,
+                       deadline_ms=deadline_ms)
         if slot is not None:
             self.on_admit(request_id, slot, t)
             self.on_first_token([request_id], t)
@@ -343,18 +440,62 @@ class RequestLedger:
     def n_open(self) -> int:
         return len(self._open)
 
+    @property
+    def n_expired(self) -> int:
+        return self.n_expired_queue + self.n_expired_live
+
+    @property
+    def n_cancelled(self) -> int:
+        return self.n_cancelled_queue + self.n_cancelled_live
+
+    def balance(self) -> dict:
+        """The exact-reconciliation identity (ISSUE 14): every
+        submitted request is in exactly one terminal state or still
+        open, and every admitted request either retired normally or
+        was evicted from a live slot.  Returns the two residuals
+        (both MUST be zero) plus the terms — the probe and the tests
+        assert `ok`."""
+        submitted_residual = self.n_submitted - (
+            self.n_retired + self.n_expired + self.n_cancelled
+            + self.n_shed + self.n_open)
+        admitted_residual = self.n_admitted - (
+            self.n_retired + self.n_expired_live + self.n_cancelled_live
+            + sum(1 for r in self._open.values()
+                  if r.admit_t is not None))
+        return {
+            "ok": submitted_residual == 0 and admitted_residual == 0,
+            "submitted_residual": submitted_residual,
+            "admitted_residual": admitted_residual,
+            "n_submitted": self.n_submitted,
+            "n_admitted": self.n_admitted,
+            "n_retired": self.n_retired,
+            "n_expired": self.n_expired,
+            "n_cancelled": self.n_cancelled,
+            "n_shed": self.n_shed,
+            "n_open": self.n_open,
+        }
+
     def summary(self) -> dict:
-        """JSON-safe digest: exact counters + the three estimator
-        summaries (seconds; the serve_record stamps convert to ms)."""
+        """JSON-safe digest: exact counters + the estimator summaries
+        (seconds; the serve_record stamps convert to ms)."""
         return {
             "n_submitted": self.n_submitted,
             "n_admitted": self.n_admitted,
             "n_retired": self.n_retired,
+            "n_expired": self.n_expired,
+            "n_expired_queue": self.n_expired_queue,
+            "n_expired_live": self.n_expired_live,
+            "n_cancelled": self.n_cancelled,
+            "n_cancelled_queue": self.n_cancelled_queue,
+            "n_cancelled_live": self.n_cancelled_live,
+            "n_shed": self.n_shed,
             "n_open": self.n_open,
+            "balance_ok": self.balance()["ok"],
             "tokens_emitted": self.tokens_emitted,
             "queue_wait_s": self.queue_wait.summary(),
             "ttft_s": self.ttft.summary(),
             "per_token_s": self.token_lat.summary(),
+            "service_s": self.service.summary(),
         }
 
     def tail_dicts(self) -> List[dict]:
@@ -382,7 +523,7 @@ class ServeTelemetry:
         self.churn_steps = 0
         self.gauges: dict = {}
         self.peaks = {"queue_depth": 0, "slots_live": 0, "pool_util": 0.0,
-                      "pages_used": 0}
+                      "pages_used": 0, "queue_saturation": 0.0}
         # per-token latency over PURE decode steps, the measure_decode
         # convention — fed by drivers that sync per step; the first
         # `step_time_warmup` recorded steps carry compiles and are
@@ -456,6 +597,12 @@ class ServeTelemetry:
             "serve_requests_retired": int(self.ledger.n_retired),
             "serve_tokens_emitted": int(self.ledger.tokens_emitted),
         }
+        # v10 (ISSUE 14): terminal-state counters — real lifetime
+        # counts like requests_retired, stamped always (0 is a real
+        # count for a healthy engine, not a missing sample)
+        rec["serve_shed_total"] = int(self.ledger.n_shed)
+        rec["serve_expired_total"] = int(self.ledger.n_expired)
+        rec["serve_cancelled_total"] = int(self.ledger.n_cancelled)
         led = self.ledger
         if led.ttft.n:
             rec["serve_ttft_p50_ms"] = 1e3 * led.ttft.percentile(50.0)
@@ -488,8 +635,9 @@ class ServeTelemetry:
 _REQUIRED_REPORT = ("serve_telemetry_version", "steps", "gauges", "peaks",
                     "ledger", "ledger_tail")
 _REQUIRED_LEDGER = ("n_submitted", "n_admitted", "n_retired", "n_open",
+                    "n_expired", "n_cancelled", "n_shed", "balance_ok",
                     "tokens_emitted", "queue_wait_s", "ttft_s",
-                    "per_token_s")
+                    "per_token_s", "service_s")
 _REQUIRED_EST = ("n", "retained", "mean", "min", "max", "p50", "p95", "p99")
 
 
@@ -514,7 +662,7 @@ def validate_serve_report(report: dict) -> None:
     for k in _REQUIRED_LEDGER:
         if k not in led:
             raise ValueError(f"missing ledger field {k!r}")
-    for axis in ("queue_wait_s", "ttft_s", "per_token_s"):
+    for axis in ("queue_wait_s", "ttft_s", "per_token_s", "service_s"):
         est = led[axis]
         if not isinstance(est, dict):
             raise ValueError(f"ledger estimator {axis!r} is not a dict")
@@ -523,9 +671,11 @@ def validate_serve_report(report: dict) -> None:
                 raise ValueError(
                     f"ledger estimator {axis!r} missing field {k!r}")
     for k in ("n_submitted", "n_admitted", "n_retired", "n_open",
-              "tokens_emitted"):
+              "n_expired", "n_cancelled", "n_shed", "tokens_emitted"):
         if not isinstance(led[k], int) or isinstance(led[k], bool):
             raise ValueError(f"ledger counter {k!r} is not an int")
+    if not isinstance(led["balance_ok"], bool):
+        raise ValueError("ledger balance_ok is not a bool")
     if not isinstance(report["ledger_tail"], list):
         raise ValueError("ledger_tail is not a list")
     for i, rec in enumerate(report["ledger_tail"]):

@@ -1,0 +1,201 @@
+"""Engine watchdog — the serving analogue of `LostRankWatchdog`
+(ISSUE 14 tentpole, layer d).
+
+A serving node's worst failure is not a crash — crashes raise, and the
+PR 9 snapshot contract already covers them.  The worst failure is a
+WEDGE: the decode loop stops making progress (a hung DMA, a deadlocked
+runtime, a driver that never completes a dispatch) while the process
+looks alive, every live client's tokens stop, and nothing raises.  The
+training plane escalates that shape of failure through
+`checkpoint.chaos.LostRankWatchdog` (persistent straggler flag →
+`RankLostError` instead of a collective hang); this module is the same
+posture re-aimed at `DecodeEngine`:
+
+* the engine bumps `steps_completed` at every step that completed its
+  retire poll — the ONE heartbeat (a stalled step, real or injected by
+  the `serve.stall_step` chaos point, never bumps it);
+* `EngineWatchdog.check()` — called by the drive loop between steps —
+  raises `EngineStalledError` naming the stuck step once the engine
+  has had live work but no heartbeat for `stall_timeout_s`, after
+  dumping a flight report whose reason names the step and the restart
+  point (no recorder schema change: the story rides the reason string,
+  the `resume_guard` convention);
+* `restart()` builds a FRESH engine of the same deployment and
+  restores the newest periodic snapshot (`snapshot_every=`), so
+  decoding resumes MID-GENERATION bitwise (`DecodeEngine.state_dict`,
+  the PR 9 contract) — replayed steps are free because greedy decode
+  is deterministic.  The snapshot is taken on the watchdog's side of
+  the heartbeat because a wedged device cannot be asked for its state
+  AFTER the wedge.
+
+`scripts/serve_chaos_probe.py` drives the stall → trip → restart →
+bitwise matrix; `MetricsLogger(serve=engine)` stamps
+`serve_watchdog_stalls` / `serve_watchdog_restarts` (SCHEMA v10).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Optional
+
+from apex_tpu.serve.engine import DecodeEngine
+
+
+class EngineStalledError(RuntimeError):
+    """The engine made no retire-poll progress within the stall
+    timeout while holding live work.  Carries the structured fields
+    the restart path needs: `step` (the heartbeat it stuck at),
+    `stalled_for_s`, and `snapshot_step` (the restart point, None when
+    no snapshot was ever taken)."""
+
+    def __init__(self, msg: str, step: Optional[int] = None,
+                 stalled_for_s: Optional[float] = None,
+                 snapshot_step: Optional[int] = None):
+        super().__init__(msg)
+        self.step = step
+        self.stalled_for_s = stalled_for_s
+        self.snapshot_step = snapshot_step
+
+
+class EngineWatchdog:
+    """Host-side stall detector + restart orchestration for one
+    `DecodeEngine`.
+
+    >>> dog = EngineWatchdog(eng, stall_timeout_s=5.0, snapshot_every=8)
+    >>> while eng.pending:
+    ...     eng.step()
+    ...     try:
+    ...         dog.check()
+    ...     except EngineStalledError:
+    ...         eng = dog.restart()      # fresh engine, bitwise resume
+
+    `clock=` is injectable so the trip threshold is testable without
+    real waiting; `snapshot_every=N` snapshots `state_dict()` every N
+    progressing steps (0 disables — `restart()` then needs a snapshot
+    handed in).  Snapshotting costs a device sync + a host copy of the
+    KV pool, so production picks a cadence the same way checkpoint
+    cadence is priced (docs/serving.md); the chaos probe runs
+    `snapshot_every=1` because its proof is bitwise, not cheap."""
+
+    def __init__(self, engine: DecodeEngine, stall_timeout_s: float = 30.0,
+                 recorder=None, snapshot_every: int = 0,
+                 clock: Callable[[], float] = time.perf_counter):
+        if stall_timeout_s <= 0:
+            raise ValueError(
+                f"stall_timeout_s must be > 0, got {stall_timeout_s}")
+        if snapshot_every < 0:
+            raise ValueError(
+                f"snapshot_every must be >= 0, got {snapshot_every}")
+        self.engine = engine
+        self.stall_timeout_s = stall_timeout_s
+        # default to the engine's own flight recorder: a restart must
+        # not silently drop crash-dump wiring the deployment attached
+        self.recorder = (recorder if recorder is not None
+                         else getattr(engine, "recorder", None))
+        self.snapshot_every = snapshot_every
+        self.clock = clock
+        self.stalls = 0
+        self.restarts = 0
+        self.snapshot: Optional[dict] = None
+        self.snapshot_step: Optional[int] = None
+        self._last_heartbeat = engine.steps_completed
+        self._last_progress_t = clock()
+        self._since_snapshot = 0
+        engine.watchdog = self
+
+    def check(self) -> None:
+        """Judge the heartbeat.  Progress (or an idle engine) resets
+        the stall clock; live work without progress past the timeout
+        raises `EngineStalledError` naming the stuck step, after
+        dumping a flight report when a recorder is attached."""
+        now = self.clock()
+        hb = self.engine.steps_completed
+        if hb != self._last_heartbeat:
+            self._last_heartbeat = hb
+            self._last_progress_t = now
+            if self.snapshot_every:
+                self._since_snapshot += 1
+                if self._since_snapshot >= self.snapshot_every:
+                    self.take_snapshot()
+            return
+        if not self.engine.pending:
+            # no work is not a stall — an idle engine has nothing to
+            # make progress ON; the clock re-arms at the next submit
+            self._last_progress_t = now
+            return
+        stalled = now - self._last_progress_t
+        if stalled <= self.stall_timeout_s:
+            return
+        self.stalls += 1
+        live = len(self.engine._live)
+        queued = len(self.engine._pending)
+        where = (f"snapshot at step {self.snapshot_step}"
+                 if self.snapshot_step is not None
+                 else "NO SNAPSHOT — restart loses in-flight work")
+        msg = (f"serve engine stalled: no retire-poll progress for "
+               f"{stalled:.2f}s (timeout {self.stall_timeout_s:.2f}s) "
+               f"stuck at step {hb} with {live} live / {queued} queued "
+               f"request(s); restart point: {where}")
+        if self.recorder is not None:
+            self.recorder.dump(reason=f"engine watchdog: {msg}")
+        raise EngineStalledError(msg, step=hb, stalled_for_s=stalled,
+                                 snapshot_step=self.snapshot_step)
+
+    def take_snapshot(self) -> Optional[dict]:
+        """Snapshot the engine NOW (device-synced `state_dict()`) —
+        the restart point.  Never call this on a suspected-stalled
+        engine: the sync would hang on the wedge; the periodic cadence
+        exists so a snapshot from BEFORE the wedge is always at hand.
+
+        The snapshot is LAST KNOWN-GOOD, not merely last: the
+        candidate's output rings are validated against the vocab
+        before it replaces the held one.  Poison is detected at
+        RETIRE time (`PoisonedOutputError`), possibly steps after the
+        injection — if the watchdog blindly kept the newest state, the
+        poison would be inside every later snapshot and restart would
+        reload the corruption forever.  A poisoned candidate is
+        refused (returns None, the previous snapshot stays) so the
+        restart always lands before the injection."""
+        snap = self.engine.state_dict()
+        ds = snap["decode_state"]
+        vocab = self.engine.model_cfg.vocab_size
+        n_gen = ds["n_generated"]
+        out = ds["out_tokens"]
+        for slot in range(out.shape[0]):
+            toks = out[slot, :int(n_gen[slot])]
+            if toks.size and (int(toks.min()) < 0
+                              or int(toks.max()) >= vocab):
+                return None            # poisoned — keep the good one
+        self.snapshot = snap
+        self.snapshot_step = self.engine.steps_completed
+        self._since_snapshot = 0
+        return self.snapshot
+
+    def restart(self, snapshot: Optional[dict] = None,
+                params=None) -> DecodeEngine:
+        """Build a FRESH engine of the same deployment, restore
+        `snapshot` (default: the newest periodic one), and re-arm the
+        watchdog on it.  The restored engine recompiles its decode
+        step on first use (fresh jit cache) and then holds the
+        zero-steady-recompile contract as before; resumed decoding is
+        BITWISE the unstalled run's (greedy decode is deterministic,
+        so replaying the steps since the snapshot reproduces them)."""
+        snap = snapshot if snapshot is not None else self.snapshot
+        if snap is None:
+            raise ValueError(
+                "EngineWatchdog.restart: no snapshot to restore "
+                "(snapshot_every=0 and none handed in)")
+        old = self.engine
+        eng = DecodeEngine(
+            old.model_cfg, params if params is not None else old.params,
+            old.serve_cfg, recorder=self.recorder,
+            telemetry=old.telemetry is not None, slo=old.slo)
+        eng.load_state_dict(snap)
+        self.restarts += 1
+        self.engine = eng
+        old.watchdog = None
+        eng.watchdog = self
+        self._last_heartbeat = eng.steps_completed
+        self._last_progress_t = self.clock()
+        self._since_snapshot = 0
+        return eng

@@ -551,6 +551,87 @@ def _serve_decode_bench(on_tpu):
     return sweep
 
 
+def _serve_overload_bench(on_tpu):
+    """The overload leg (ISSUE 14): a 4x-slot-capacity storm with
+    mixed deadlines against a BOUNDED admission queue — what the
+    serving plane does when traffic exceeds it, measured instead of
+    assumed.  Stamps (via _stamp_serve_overload): `serve_shed_fraction`
+    (shed+expired fraction of submissions — how much the engine
+    refused to protect the rest) and `serve_goodput_tokens_per_sec`
+    (tokens of requests that completed OK per wall second — the
+    number overload control exists to protect; contrast with
+    `serve_decode_tokens_per_sec`, which is raw decode throughput
+    under healthy load).  The ledger's terminal-state balance and the
+    page-pool reconciliation are correctness gates: a False voids the
+    stamp."""
+    import time as _t
+
+    import numpy as np
+
+    from apex_tpu.serve import build_flagship_engine
+    from apex_tpu.serve.engine import flagship_n_slots
+
+    n_slots = flagship_n_slots(on_tpu)
+    eng = build_flagship_engine(
+        on_tpu, serve_overrides={"max_queue_depth": 2 * n_slots,
+                                 "shed_policy": "shed-lowest-deadline"})
+    n_requests = 4 * n_slots
+    max_new = eng.serve_cfg.max_new_cap if on_tpu else 8
+    rng = np.random.RandomState(0)
+    mp = eng.serve_cfg.max_prompt_len
+    t0 = _t.perf_counter()
+    for i in range(n_requests):
+        plen = int(rng.randint(1, mp + 1))
+        budget = int(rng.randint(1, max_new + 1))
+        # mixed deadlines: half the storm carries a finite deadline
+        # (the shed policy's victim-ordering pool), half is unbounded
+        dl = 120_000.0 if i % 2 else None
+        eng.submit(rng.randint(0, eng.model_cfg.vocab_size,
+                               plen).tolist(), budget, deadline_ms=dl)
+    fins = {}
+    steps = 0
+    while eng.pending:
+        if steps >= n_requests * max_new + 64:
+            raise RuntimeError("overload storm did not drain")
+        eng.step()
+        for f in eng.poll():
+            fins[f.request_id] = f
+        steps += 1
+    wall = _t.perf_counter() - t0
+    led = eng.telemetry.ledger
+    good_tokens = sum(len(f.tokens) for f in fins.values()
+                      if f.status == "ok")
+    return {
+        "n_requests": n_requests,
+        "n_ok": led.n_retired,
+        "n_shed": led.n_shed,
+        "n_expired": led.n_expired,
+        "shed_fraction": (led.n_shed + led.n_expired) / n_requests,
+        "goodput_tokens_per_sec": round(good_tokens / wall, 1),
+        "good_tokens": good_tokens,
+        "steps": steps,
+        "balance_ok": led.balance()["ok"],
+        "pool_reconciled": (eng.cache.free_pages
+                            == eng.kv_config.usable_pages),
+        "recompile_ok": eng.recompile_ok,
+        "queue_saturation_peak": round(
+            eng.telemetry.peaks["queue_saturation"], 4),
+    }
+
+
+def _stamp_serve_overload(result, leg):
+    """Flat v10 overload scalars + the dict under `serving_overload`.
+    The correctness gates (balance/pool/sentry) must hold for the
+    stamps to land — a storm that corrupted accounting has no
+    goodput number worth publishing."""
+    result["serving_overload"] = leg
+    if (leg["balance_ok"] and leg["pool_reconciled"]
+            and leg["recompile_ok"]):
+        result["serve_shed_fraction"] = float(leg["shed_fraction"])
+        result["serve_goodput_tokens_per_sec"] = float(
+            leg["goodput_tokens_per_sec"])
+
+
 def _stamp_serve(result, sweep):
     """Fold the serve sweep into the result JSON: the full dict under
     `serving` (deliberately OUTSIDE the `serve_` prefix — that prefix
@@ -1106,6 +1187,15 @@ def main():
         _stamp_serve(result, sweep)
     except Exception as e:
         result["serve_error"] = repr(e)[:120]
+    # serving overload leg (ISSUE 14): the 4x storm against a bounded
+    # queue — shed fraction + goodput under overload control
+    # (_stamp_serve_overload: flat v10 scalars + `serving_overload`)
+    try:
+        with _timed(durations, "serve_overload"):
+            overload = _retry(_serve_overload_bench, on_tpu)
+        _stamp_serve_overload(result, overload)
+    except Exception as e:
+        result["serve_overload_error"] = repr(e)[:120]
     # checkpoint-cadence pricing (ISSUE 9): one async save → elastic
     # restore cycle of the ZeRO-2 flagship state, stamped as flat
     # ckpt_* v6 scalars (+ the dict under `checkpointing`)

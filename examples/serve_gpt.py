@@ -20,6 +20,15 @@ ledger summary (TTFT / queue-wait percentiles, pool-utilization peak
 are given, exits nonzero on a `ServeSLO` breach verdict with the
 violated axis named — the same posture as the sentry trip.
 
+Resilience (ISSUE 14): `--deadline-ms` attaches a TTL to every
+request (expired ones are evicted, terminal state `expired`), and the
+process installs a SIGTERM handler that runs the GRACEFUL DRAIN path
+— stop admission, finish live slots, snapshot the queued remainder —
+and exits nonzero if any live request was lost to a non-ok terminal.
+`--drain-after-steps N` triggers the same path deterministically
+after N engine steps (the tier-1 CI gate for the drain path; sending
+a real SIGTERM mid-run exercises the identical code).
+
 On a CPU backend the smoke-size model substitutes through the same
 build path (`serve.build_flagship_engine`) — shapes shrink, the
 scheduler/recompile story is identical.
@@ -30,8 +39,43 @@ import _bootstrap  # noqa: F401 — repo root on sys.path
 _bootstrap.force_cpu_devices_from_argv()
 
 import argparse  # noqa: E402
+import signal    # noqa: E402
 import sys       # noqa: E402
 import time      # noqa: E402
+
+# set by the SIGTERM handler; checked between engine steps — a signal
+# handler must never call drain() re-entrantly under a running step
+_DRAIN_REQUESTED = False
+
+
+def _on_sigterm(signum, frame):
+    global _DRAIN_REQUESTED
+    _DRAIN_REQUESTED = True
+
+
+def _drain_and_report(eng, finished_by_rid, live_before):
+    """The ONE drain path (SIGTERM and --drain-after-steps both land
+    here): drain(), account for every request that was live when the
+    drain began, and return an exit code — nonzero if any of them was
+    LOST (no terminal record at all) or ended in a non-ok terminal."""
+    snap = eng.drain()
+    for f in eng.poll():
+        finished_by_rid[f.request_id] = f
+    queued = len(snap["scheduler"]["pending"])
+    lost = [rid for rid in live_before if rid not in finished_by_rid]
+    bad = [rid for rid in live_before
+           if rid in finished_by_rid
+           and finished_by_rid[rid].status != "ok"]
+    print(f"drain: {len(live_before)} live finished, {queued} queued "
+          f"request(s) in the restorable snapshot "
+          f"(serve_state_version "
+          f"{snap['serve_state_version']})")
+    if lost or bad:
+        print(f"FAIL: drain lost request(s) {lost} / non-ok terminals "
+              f"{bad}", file=sys.stderr)
+        return 1
+    print("serve_gpt: drain OK (no live request lost)")
+    return 0
 
 
 def main():
@@ -51,6 +95,14 @@ def main():
     ap.add_argument("--slo-token-p99-ms", type=float, default=None,
                     help="fail (exit nonzero) if the per-token p99 "
                          "exceeds this many ms")
+    ap.add_argument("--deadline-ms", type=float, default=None,
+                    help="per-request TTL: requests not served within "
+                         "this many ms are evicted (terminal state "
+                         "'expired') — ISSUE 14")
+    ap.add_argument("--drain-after-steps", type=int, default=None,
+                    help="run the graceful-drain path after N engine "
+                         "steps (same code as SIGTERM) and exit — "
+                         "nonzero if any live request is lost")
     ap.add_argument("--force-cpu-devices", type=int, default=0,
                     help="emulate N CPU devices (consumed by "
                          "_bootstrap before jax init)")
@@ -82,18 +134,51 @@ def main():
     for _ in range(args.streams):
         plen = int(rng.randint(1, mp + 1))
         prompt = rng.randint(0, eng.model_cfg.vocab_size, plen).tolist()
-        rids.append(eng.submit(prompt, max_new))
+        rids.append(eng.submit(prompt, max_new,
+                               deadline_ms=args.deadline_ms))
+
+    # graceful shutdown for deploys (ISSUE 14): SIGTERM requests a
+    # drain; the drive loops below honor it between steps
+    signal.signal(signal.SIGTERM, _on_sigterm)
+
+    if args.drain_after_steps is not None:
+        # the CI-drivable drain gate: N steps of normal serving, then
+        # the exact SIGTERM path
+        fins = {}
+        for _ in range(args.drain_after_steps):
+            if not eng.pending:
+                break
+            eng.step()
+            for f in eng.poll():
+                fins[f.request_id] = f
+        live = [r.rid for r in eng._live.values()]
+        return _drain_and_report(eng, fins, live)
 
     t0 = time.perf_counter()
     try:
         # sequential worst case bounds the drive so a scheduler
-        # regression FAILS the gate instead of hanging it
-        m = measure_decode(eng, max_steps=args.streams * max_new + 64)
+        # regression FAILS the gate instead of hanging it; the stop=
+        # hook ends the drive between steps when SIGTERM lands, so
+        # the drain below runs with the remainder genuinely pending
+        m = measure_decode(eng, max_steps=args.streams * max_new + 64,
+                           stop=lambda: _DRAIN_REQUESTED)
     except RuntimeError as e:
         print(f"FAIL: {e}", file=sys.stderr)
         return 1
     wall = time.perf_counter() - t0
     finished = m["finished"]
+
+    if _DRAIN_REQUESTED:
+        # SIGTERM landed mid-run: measure_decode returned between
+        # steps with the remainder still pending — finish the live
+        # slots, snapshot the queue, audit for lost work.  BEFORE the
+        # stats prints: an early signal may have stopped the drive
+        # with zero finished requests, and losing the drain to a
+        # stats-formatting crash is the exact outcome this path exists
+        # to prevent
+        fins = {f.request_id: f for f in finished}
+        return _drain_and_report(
+            eng, fins, [r.rid for r in eng._live.values()])
 
     n_tok = sum(len(f.tokens) for f in finished)
     print(f"decoded {len(finished)} requests / {n_tok} tokens in "
@@ -117,6 +202,12 @@ def main():
         print(f"FAIL: {args.streams - len(finished)} request(s) never "
               "retired", file=sys.stderr)
         return 1
+    n_expired = eng.telemetry.ledger.n_expired
+    if args.deadline_ms is not None and n_expired:
+        print(f"deadline plane: {n_expired} request(s) expired at "
+              f"--deadline-ms {args.deadline_ms:g} (terminal state "
+              "'expired'; balance "
+              f"{eng.telemetry.ledger.balance()['ok']})")
 
     # the serving observatory (ISSUE 10): the request-lifecycle
     # ledger's live percentiles, and — when an SLO is given — the

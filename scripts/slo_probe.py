@@ -208,7 +208,11 @@ def probe(args) -> int:
     result["churn_steps"] = m["churn_steps"]
     result["tokens_per_sec"] = round(m["tokens_per_sec"], 1)
 
-    # 1. ledger <-> engine reconciliation (exact)
+    # 1. ledger <-> engine reconciliation (exact).  A healthy
+    # deadline-less run must also show ZERO terminal casualties and a
+    # closed balance identity (ISSUE 14): expiry/shed/cancel firing
+    # here would mean the resilience plane steers healthy traffic.
+    bal = led.balance()
     ok = (led.n_submitted == led.n_admitted == led.n_retired
           == m["admitted"] == m["retired"] == n_requests
           and led.n_open == 0)
@@ -220,6 +224,13 @@ def probe(args) -> int:
             f"step() sums admitted {m['admitted']} / retired "
             f"{m['retired']} over {n_requests} requests "
             f"({led.n_open} still open)")
+    if not bal["ok"]:
+        failures.append(f"terminal-state balance violated: {bal}")
+    if led.n_shed or led.n_expired or led.n_cancelled:
+        failures.append(
+            f"healthy run hit terminal states: shed {led.n_shed} / "
+            f"expired {led.n_expired} / cancelled {led.n_cancelled} — "
+            "the resilience plane fired on deadline-less traffic")
     fin_tokens = {f.request_id: len(f.tokens) for f in m["finished"]}
     tail = {r.request_id: r for r in led.tail}
     if set(fin_tokens) != set(rids):

@@ -88,3 +88,16 @@ def test_serve_gpt_runs_64_streams():
     assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
     assert "serve_gpt: OK (zero steady-state recompiles)" in r.stdout
     assert "decoded 64 requests" in r.stdout
+
+
+def test_serve_gpt_drain_path_64_streams():
+    """ISSUE 14 satellite: the graceful-drain path (the SIGTERM
+    handler's exact code, driven deterministically) at N=64 CPU —
+    every live request finishes, the queued remainder rides the
+    restorable snapshot, and the script exits nonzero if any live
+    request is lost."""
+    r = _run("serve_gpt.py", "--streams", "64", "--max-new", "8",
+             "--drain-after-steps", "6", "--force-cpu-devices", "1")
+    assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
+    assert "serve_gpt: drain OK (no live request lost)" in r.stdout
+    assert "restorable snapshot" in r.stdout
