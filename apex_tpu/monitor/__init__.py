@@ -28,6 +28,14 @@ Three layers:
                overlap classification, and the per-device-kind ICI
                roofline (`monitor.comms` subpackage; CI-gated by
                `scripts/comms_probe.py`)
+  * timeline — the runtime timeline observatory (ISSUE 15): parses
+               the profiler traces `ProfileCapture` writes into a
+               MEASURED per-step anatomy (`analyze_trace` ->
+               `TimelineReport`: device-busy/host-gap, category
+               attribution, per-collective measured overlap) and
+               cross-checks the comms plane's predictions
+               (`crosscheck_comms`; CI-gated by
+               `scripts/timeline_probe.py`)
 
 See docs/observability.md for the JSONL schema and recipes, and
 examples/train_with_monitor.py for the end-to-end loop.
@@ -59,6 +67,16 @@ from apex_tpu.monitor.comms import (  # noqa: F401
     device_link_bandwidth,
     render_comms_table,
 )
+from apex_tpu.monitor import timeline  # noqa: F401
+from apex_tpu.monitor.timeline import (  # noqa: F401
+    TIMELINE_SCHEMA_VERSION,
+    TimelineReport,
+    TraceParseError,
+    analyze_trace,
+    crosscheck_comms,
+    render_timeline_table,
+    validate_timeline_report,
+)
 from apex_tpu.monitor.logger import (  # noqa: F401
     SCHEMA,
     SCHEMA_VERSION,
@@ -74,7 +92,11 @@ from apex_tpu.monitor.metrics import (  # noqa: F401
     init_metrics,
     update_metrics,
 )
-from apex_tpu.monitor.profiler import ProfileCapture, profile_capture  # noqa: F401
+from apex_tpu.monitor.profiler import (  # noqa: F401
+    ProfileCapture,
+    ProfileStepReentryError,
+    profile_capture,
+)
 from apex_tpu.monitor.sinks import (  # noqa: F401
     ConsoleSink,
     JSONLSink,

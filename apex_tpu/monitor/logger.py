@@ -106,7 +106,18 @@ from apex_tpu.monitor.sinks import MetricSink, ScalarWriter
 # tokens of requests that completed OK per second, the number overload
 # control exists to protect).  All OPTIONAL, never-null when present;
 # same reserved `serve_` scalar prefix.
-SCHEMA_VERSION = 10
+# v11 (ISSUE 15): the runtime-timeline fields, stamped by
+# `MetricsLogger(timeline=report)` from a measured `TimelineReport`
+# (monitor.timeline over a ProfileCapture trace) —
+# `timeline_device_busy_fraction` (union of device-event intervals
+# over step wall time), `timeline_host_gap_ms` (mean per-step device
+# idle: wall − busy), `timeline_collective_fraction` (collective share
+# of device wall time), `timeline_measured_overlap_ok` (no collective
+# span measured serialized — stamped ONLY where the schedule is
+# measurable, i.e. TPU traces; a CPU capture simply doesn't stamp it,
+# never a null).  All OPTIONAL, never-null when present; `timeline_`
+# joins the reserved scalar prefixes.
+SCHEMA_VERSION = 11
 
 # field -> (python type, finite_required).  loss_scale may legitimately
 # be large but is finite; grad/update norms are inf/nan ON overflow
@@ -210,9 +221,17 @@ OPTIONAL_SCHEMA = {
     "serve_watchdog_restarts": (int, False),
     "serve_shed_fraction": (float, False),
     "serve_goodput_tokens_per_sec": (float, False),
+    # v11 (ISSUE 15): the measured runtime timeline.  Fractions/gap
+    # stamp whenever a TimelineReport is attached; the overlap verdict
+    # stamps only from a trace whose schedule is measurable (TPU) —
+    # never null.
+    "timeline_device_busy_fraction": (float, False),
+    "timeline_host_gap_ms": (float, False),
+    "timeline_collective_fraction": (float, False),
+    "timeline_measured_overlap_ok": (bool, False),
 }
 _OPTIONAL_PREFIXES = ("compile_", "hbm_", "comms_", "serve_", "ckpt_",
-                      "fleet_", "moe_")
+                      "fleet_", "moe_", "timeline_")
 
 
 def validate_record(record: dict, prev_step: Optional[int] = None) -> None:
@@ -306,7 +325,8 @@ class MetricsLogger:
                  ckpt=None,
                  serve=None,
                  fleet=None,
-                 moe=None):
+                 moe=None,
+                 timeline=None):
         self.sinks = list(sinks)
         self.flops_per_step = flops_per_step
         # None resolves the per-chip peak from the device kind (ISSUE 5
@@ -352,6 +372,15 @@ class MetricsLogger:
         # loss they degrade.  Host-side only: the trainer updates the
         # recorder with the aux pytree the step already returns.
         self.moe = moe
+        # timeline: a monitor.timeline.TimelineReport (anything with
+        # .timeline_record()) — every record gains the v11 timeline_*
+        # measured-anatomy scalars (ISSUE 15): a run that captured a
+        # profiler window stamps what the schedule actually did next
+        # to the step-times it explains.  Assignable after
+        # construction (`logger.timeline = analyze_trace(path)`), the
+        # natural order — the trace only exists once the capture
+        # window closed mid-run.
+        self.timeline = timeline
         # taps=True: log_step(…, taps=tap_state) folds the flight
         # recorder's per-layer stat planes into each record as compact
         # summary fields (tap_fwd_absmax / tap_grad_absmax /
@@ -457,6 +486,8 @@ class MetricsLogger:
             record.update(self.fleet.stats())
         if self.moe is not None:
             record.update(self.moe.moe_record())
+        if self.timeline is not None:
+            record.update(self.timeline.timeline_record())
         if extra:
             record.update(extra)
         for s in self.sinks:

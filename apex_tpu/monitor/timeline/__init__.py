@@ -1,0 +1,45 @@
+"""apex_tpu.monitor.timeline — the runtime timeline observatory
+(ISSUE 15).
+
+Where `monitor.comms` predicts overlap from HLO structure before
+anything runs, this package MEASURES what the scheduler did, from the
+Chrome trace-event JSON (`trace.json.gz`) that `jax.profiler` /
+`monitor.ProfileCapture` writes:
+
+  * events  — backend-free trace parser (`read_trace`, named
+              `TraceParseError` on truncated/corrupt files)
+  * report  — `analyze_trace(path) -> TimelineReport`: per-step device
+              busy fraction + host gap, wall-time category attribution
+              (gemm / collective / infeed / other, the comms HLO
+              heuristics), MEASURED per-collective overlap fraction,
+              `crosscheck_comms` against a `CommsReport`, the v1
+              schema + validator + renderer
+
+CI-gated by `scripts/timeline_probe.py` (flagship capture + parse
+asserts + committed-fixture drift gate + seeded idle-heavy negative
+control).  See docs/observability.md "Reading the timeline".
+"""
+
+from apex_tpu.monitor.timeline.events import (  # noqa: F401
+    TraceEvent,
+    TraceEvents,
+    TraceParseError,
+    newest_trace,
+    parse_trace,
+    read_trace,
+)
+from apex_tpu.monitor.timeline.report import (  # noqa: F401
+    CATEGORIES,
+    IDLE_BUSY_FLOOR,
+    TIMELINE_SCHEMA_VERSION,
+    CollectiveSpan,
+    StepAnatomy,
+    TimelineReport,
+    analyze_events,
+    analyze_trace,
+    classify_op,
+    crosscheck_comms,
+    render_crosscheck,
+    render_timeline_table,
+    validate_timeline_report,
+)

@@ -1,0 +1,208 @@
+"""Chrome trace-event parsing for the runtime timeline observatory.
+
+`jax.profiler.start_trace`/`stop_trace` (and therefore
+`monitor.ProfileCapture`) write a Chrome trace-event JSON —
+`<host>.trace.json.gz` under `logdir/plugins/profile/<stamp>/` — that
+nothing in the repo ever read back: the comms observatory (ISSUE 7)
+classifies *expected* overlap from HLO structure, but the trace is the
+only artifact that records what the scheduler actually DID.  This
+module is the backend-free half of closing that loop: it parses the
+trace file into typed events without importing jax, so the analysis
+layer (`timeline/report.py`) and its tests run on committed/.
+
+The format (one JSON object, `traceEvents` list):
+
+  * `"ph": "M"` metadata events name processes and threads —
+    `process_name` args carry `/device:TPU:0`-style names on TPU and
+    `/host:CPU` on CPU (where XLA's thunk executor threads play the
+    device role), `thread_name` labels the per-pid lanes ("XLA Ops"
+    on TPU device pids, `tf_XLATfrtCpuClient/…` on CPU).
+  * `"ph": "X"` complete events carry `ts`/`dur` in MICROSECONDS.
+    Device-executed HLO ops carry `args.hlo_op` (the instruction name
+    of the OPTIMIZED module — the same namespace the comms
+    observatory's inventory uses, which is what makes the
+    predicted-vs-measured crosscheck exact); `StepTraceAnnotation`
+    step markers carry `args.step_num`.
+
+Anything else (`B`/`E` pairs, counters, flow events) is ignored —
+jax's converter emits complete events only, and the analysis needs
+nothing more.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import gzip
+import json
+import os
+from typing import Dict, List, Optional, Tuple
+
+
+class TraceParseError(ValueError):
+    """A profiler trace that cannot be parsed — truncated/corrupt gzip,
+    invalid JSON, or JSON that is not a Chrome trace-event object.  The
+    NAMED error every malformed-trace path raises (the analysis layer
+    never lets a bad file escape as a bare json/gzip exception)."""
+
+
+@dataclasses.dataclass
+class TraceEvent:
+    """One complete ("X") trace event.  ts/dur in microseconds."""
+
+    name: str
+    pid: int
+    tid: int
+    ts: float
+    dur: float
+    hlo_op: str                 # args.hlo_op ("" when absent)
+    step_num: Optional[int]     # args.step_num (step annotations only)
+
+    @property
+    def end(self) -> float:
+        return self.ts + self.dur
+
+
+@dataclasses.dataclass
+class TraceEvents:
+    """A parsed trace: complete events + the process/thread name maps
+    the metadata events declared."""
+
+    events: List[TraceEvent]
+    process_names: Dict[int, str]
+    thread_names: Dict[Tuple[int, int], str]
+    path: Optional[str] = None
+
+
+def load_trace(path: str) -> dict:
+    """Read a `trace.json[.gz]` file into its JSON object.  Raises
+    TraceParseError (never a bare gzip/json error) on a truncated or
+    corrupt file — a preempted capture must degrade to a named,
+    catchable failure, not a crash in the analysis pipeline."""
+    try:
+        if path.endswith(".gz"):
+            with gzip.open(path, "rt", encoding="utf-8") as f:
+                obj = json.load(f)
+        else:
+            with open(path, "r", encoding="utf-8") as f:
+                obj = json.load(f)
+    except FileNotFoundError:
+        raise
+    except (OSError, EOFError, ValueError, UnicodeDecodeError) as e:
+        # gzip truncation raises EOFError/BadGzipFile(OSError); json
+        # garbage raises JSONDecodeError(ValueError) — one named error
+        raise TraceParseError(
+            f"cannot parse profiler trace {path!r}: {e}") from e
+    if not isinstance(obj, dict):
+        raise TraceParseError(
+            f"profiler trace {path!r} is not a trace-event object "
+            f"(got {type(obj).__name__})")
+    return obj
+
+
+def parse_trace(obj: dict, path: Optional[str] = None) -> TraceEvents:
+    """Parse a Chrome trace-event JSON object (the `load_trace` result,
+    or a hand-authored fixture dict) into typed events."""
+    if not isinstance(obj, dict) or "traceEvents" not in obj:
+        raise TraceParseError(
+            "trace object has no 'traceEvents' list — not a Chrome "
+            "trace-event dump")
+    raw = obj["traceEvents"]
+    if not isinstance(raw, list):
+        raise TraceParseError(
+            f"'traceEvents' is {type(raw).__name__}, not a list")
+    events: List[TraceEvent] = []
+    process_names: Dict[int, str] = {}
+    thread_names: Dict[Tuple[int, int], str] = {}
+    for e in raw:
+        if not isinstance(e, dict):
+            continue
+        ph = e.get("ph")
+        args = e.get("args") or {}
+        if ph == "M":
+            # same contract as the X branch: a malformed metadata row
+            # (non-numeric pid from a foreign converter) costs the
+            # ROW, never the trace
+            try:
+                if e.get("name") == "process_name":
+                    process_names[int(e.get("pid", 0))] = str(
+                        args.get("name", ""))
+                elif e.get("name") == "thread_name":
+                    thread_names[(int(e.get("pid", 0)),
+                                  int(e.get("tid", 0)))] = str(
+                        args.get("name", ""))
+            except (TypeError, ValueError):
+                pass
+            continue
+        if ph != "X":
+            continue
+        step_num = args.get("step_num")
+        if step_num is not None:
+            try:
+                step_num = int(step_num)  # serialized as a string
+            except (TypeError, ValueError):
+                step_num = None
+        try:
+            events.append(TraceEvent(
+                name=str(e.get("name", "")),
+                pid=int(e.get("pid", 0)),
+                tid=int(e.get("tid", 0)),
+                ts=float(e.get("ts", 0.0)),
+                dur=float(e.get("dur", 0.0)),
+                hlo_op=str(args.get("hlo_op", "")),
+                step_num=step_num))
+        except (TypeError, ValueError):
+            continue  # a malformed row costs the EVENT, never the trace
+    return TraceEvents(events=events, process_names=process_names,
+                       thread_names=thread_names, path=path)
+
+
+def read_trace(path: str) -> TraceEvents:
+    """load_trace + parse_trace in one call."""
+    return parse_trace(load_trace(path), path=path)
+
+
+def newest_trace(logdir: str) -> Optional[str]:
+    """The newest `*.trace.json.gz` under `logdir` (jax writes it to
+    `plugins/profile/<timestamp>/<host>.trace.json.gz`), or None when
+    no trace exists — what `ProfileCapture.trace_path()` resolves."""
+    newest, newest_m = None, -1.0
+    for root, _, files in os.walk(logdir):
+        for f in files:
+            if f.endswith(".trace.json.gz") or f.endswith(".trace.json"):
+                p = os.path.join(root, f)
+                try:
+                    m = os.path.getmtime(p)
+                except OSError:
+                    continue
+                if m > newest_m:
+                    newest, newest_m = p, m
+    return newest
+
+
+def merged_length(intervals: List[Tuple[float, float]]) -> float:
+    """Total covered length of a list of (start, end) intervals with
+    overlaps merged — the device-busy union."""
+    if not intervals:
+        return 0.0
+    intervals = sorted(intervals)
+    total = 0.0
+    cur_s, cur_e = intervals[0]
+    for s, e in intervals[1:]:
+        if s > cur_e:
+            total += cur_e - cur_s
+            cur_s, cur_e = s, e
+        else:
+            cur_e = max(cur_e, e)
+    total += cur_e - cur_s
+    return total
+
+
+def clipped(intervals: List[Tuple[float, float]], lo: float,
+            hi: float) -> List[Tuple[float, float]]:
+    """Intervals clipped to the [lo, hi] window (empties dropped)."""
+    out = []
+    for s, e in intervals:
+        s2, e2 = max(s, lo), min(e, hi)
+        if e2 > s2:
+            out.append((s2, e2))
+    return out

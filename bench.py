@@ -975,6 +975,71 @@ def _run_isolated(metric):
         f"no JSON line containing {metric!r} in --only child stdout")
 
 
+def _timeline_anatomy(on_tpu, batch, seq, cfg, master_dtype):
+    """Measured runtime anatomy of the flagship program (ISSUE 15):
+    the SAME tp_dp step `_compile_audit_350m` audits, executed for two
+    warmup + three captured steady steps under a `ProfileCapture`, the
+    trace parsed by `monitor.timeline`.  Returns the v11 `timeline_*`
+    stamps + the full report dict.  Runs in its OWN `_timed` key, the
+    compile_audit rule: trace capture adds profiler overhead to every
+    step it wraps, and parsing walks the whole event list — neither
+    may land inside a timed metric window the bench keeps comparable
+    across rounds."""
+    import tempfile
+
+    from apex_tpu import monitor
+    from apex_tpu.models.gpt import GPT
+    from apex_tpu.optimizers.fused_adam import FusedAdam
+    from apex_tpu.parallel import mesh as M
+    from apex_tpu.transformer.training import (
+        init_sharded_optimizer,
+        make_tp_dp_train_step,
+    )
+
+    M.destroy_model_parallel()
+    mesh = M.initialize_model_parallel(devices=jax.devices()[:1])
+    model = GPT(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+    opt = FusedAdam(lr=1e-4, use_pallas=on_tpu, master_dtype=master_dtype)
+    state = init_sharded_optimizer(opt, model, params, mesh)
+    step = make_tp_dp_train_step(model, opt, mesh, donate=True)
+    del params
+    tok = jnp.zeros((batch, seq), jnp.int32)
+    cap = monitor.profile_capture(
+        range(3), logdir=tempfile.mkdtemp(prefix="bench_timeline_"))
+    try:
+        # two warmups absorb the compile + the donated-layout second
+        # compile so the captured window holds STEADY steps only
+        for _ in range(2):
+            state, loss = step(state, tok, tok)
+        jax.block_until_ready(state)
+        for i in range(3):
+            with cap.step(i):
+                state, loss = step(state, tok, tok)
+                jax.block_until_ready(loss)
+    finally:
+        # a raise mid-capture must still stop the jax profiler: a
+        # leaked open trace poisons _retry's next attempt
+        # ("already started") and silently profiles every later leg
+        cap.close()
+        M.destroy_model_parallel()
+    rep = monitor.analyze_trace(cap.trace_path())
+    if rep.n_device_events == 0 or len(rep.steps) != 3:
+        raise RuntimeError(
+            f"timeline capture malformed: {rep.n_device_events} device "
+            f"event(s), {len(rep.steps)} step(s) of 3")
+    return {"record": rep.timeline_record(), "report": rep.to_dict()}
+
+
+def _stamp_timeline(result, d):
+    """Flat v11 timeline_* scalars (busy fraction, host gap,
+    collective fraction, and — only where the schedule is measurable —
+    the measured-overlap verdict) + the full per-step report under the
+    unreserved `timeline` key."""
+    result.update(d["record"])
+    result["timeline"] = d["report"]
+
+
 def _compile_audit_350m(on_tpu, batch, seq, cfg, master_dtype):
     """AOT compile & HBM audit of the flagship step (ISSUE 5): the
     memory/cost anatomy + the donation check + the flops cross-check
@@ -1221,6 +1286,18 @@ def main():
         result["long_context_32k_tokens_per_sec"] = round(lc_tps, 1)
     except Exception as e:
         result["long_context_error"] = repr(e)[:120]
+    # runtime timeline (ISSUE 15): 3 measured steady steps of the
+    # flagship program under a ProfileCapture, parsed into the flat
+    # v11 timeline_* scalars (+ the per-step report dict).  Own
+    # _timed key — same rule as compile_audit: capture overhead never
+    # lands in a timed metric window
+    try:
+        with _timed(durations, "timeline"):
+            tl = _retry(_timeline_anatomy, on_tpu, batch, seq, cfg,
+                        master_dtype)
+        _stamp_timeline(result, tl)
+    except Exception as e:
+        result["timeline_error"] = repr(e)[:120]
     try:
         with _timed(durations, "kernel_smoke"):
             ok, fails = _kernel_smoke()

@@ -17,6 +17,14 @@ in the loop dumps a JSON crash report to PATH (render with
 step N to exercise exactly that path (the crash-dump integrity test,
 tests/test_trace.py).
 
+`--profile-steps A:B` arms a `ProfileCapture` over steps [A, B) and,
+after the loop, parses the trace it wrote with the runtime timeline
+observatory (ISSUE 15): the measured per-step anatomy table prints
+(device-busy fraction, host gap, category split), the last records
+stamp the `timeline_*` SCHEMA fields, and the script exits nonzero if
+the trace parsed to zero device events — the tier-1 gate that the
+capture → parse → anatomy loop stays wired end to end.
+
 `--ckpt-dir PATH` arms preemption-proof checkpointing (ISSUE 9): a
 `checkpoint.CheckpointManager` saves the optimizer + scaler state
 every `--ckpt-every` steps (async, atomic-manifest commit), the logger
@@ -55,6 +63,10 @@ def main():
     ap.add_argument("--jsonl", default="/tmp/train_with_monitor.jsonl")
     ap.add_argument("--profile-dir", default=None,
                     help="arm profile_capture over steps 1-2, traces here")
+    ap.add_argument("--profile-steps", default=None, metavar="A:B",
+                    help="capture steps [A, B) and print the measured "
+                         "timeline anatomy after the loop (traces land "
+                         "in --profile-dir or a temp dir)")
     ap.add_argument("--flight-report", default=None,
                     help="arm the numerics flight recorder; crash "
                          "report JSON dumps here")
@@ -150,8 +162,23 @@ def main():
     metrics = monitor.init_metrics()
     timers = Timers()
 
-    cap = (monitor.profile_capture(range(1, 3), logdir=args.profile_dir)
-           if args.profile_dir else monitor.ProfileCapture(()))
+    if args.profile_steps:
+        import tempfile
+        try:
+            a, b = (int(x) for x in args.profile_steps.split(":"))
+        except ValueError:
+            raise SystemExit(
+                f"--profile-steps wants A:B, got {args.profile_steps!r}")
+        if b <= a:
+            raise SystemExit("--profile-steps A:B needs A < B")
+        cap = monitor.profile_capture(
+            range(a, b), logdir=args.profile_dir
+            or tempfile.mkdtemp(prefix="train_with_monitor_trace_"))
+    elif args.profile_dir:
+        cap = monitor.profile_capture(range(1, 3),
+                                      logdir=args.profile_dir)
+    else:
+        cap = monitor.ProfileCapture(())
 
     key = jax.random.PRNGKey(1)
 
@@ -249,6 +276,16 @@ def main():
                 tap_state, rank_timings = out[4], out[5]
                 timers("train-step").stop(block=True)
             prev_durations = (time.perf_counter() - t0, 0.0)
+            if args.profile_steps and logger.timeline is None \
+                    and not cap.active:
+                # the capture window just closed mid-run: parse the
+                # trace NOW so the remaining records stamp the v11
+                # timeline_* fields (trace_path() is None until the
+                # window fired — early steps skip this at the cost of
+                # a directory scan)
+                _tp = cap.trace_path()
+                if _tp is not None:
+                    logger.timeline = monitor.analyze_trace(_tp)
             rec = logger.log_step(
                 metrics, taps=tap_state,
                 tap_names=step.tap_names() if flight else None)
@@ -266,6 +303,21 @@ def main():
                 raise RuntimeError(
                     f"injected crash at step {i} (--crash-at)")
     cap.close()
+    if args.profile_steps:
+        rep = logger.timeline
+        if rep is None:
+            tp = cap.trace_path()
+            if tp is None:
+                raise SystemExit(
+                    "--profile-steps: no trace was captured — does the "
+                    "window overlap [0, --steps)?")
+            rep = monitor.analyze_trace(tp)
+        print(monitor.render_timeline_table(
+            rep, label=f"steps {args.profile_steps}"))
+        if rep.n_device_events == 0:
+            raise SystemExit(
+                "--profile-steps: the trace parsed to ZERO device "
+                "events — the capture wiring is broken")
     if manager is not None:
         manager.wait()
         print(f"last committed checkpoint: step "

@@ -1,0 +1,259 @@
+"""Schema-aware diff of two bench JSONs (ISSUE 15 satellite).
+
+usage:
+  python scripts/bench_diff.py OLD.json NEW.json [--threshold PCT]
+  python scripts/bench_diff.py OLD.json NEW.json --json
+  python scripts/bench_diff.py --selftest
+
+The BENCH_r*.json trajectory is the repo's perf memory, but comparing
+rounds has been a by-hand `diff <(jq .) <(jq .)` affair — and a raw
+diff has no idea that tokens/s going DOWN is a regression while p99
+going DOWN is an improvement.  This tool knows the schema's
+directions: every top-level numeric metric of the two files is
+compared, the delta judged direction-aware (throughput/MFU/busy
+fraction up = good; latencies, p99s, comm/drop/shed fractions, host
+gap down = good; verdict booleans True→False = regression outright),
+and the exit code is nonzero when any metric regressed beyond
+`--threshold` percent (default 5%) — CI-composable, like every other
+gate in scripts/.
+
+Metrics only one side carries are listed (new/gone) but never judged;
+metrics with no known direction print their delta with verdict `n/a`
+(a number moving is information, guessing its polarity is not).
+Harness wall-clocks (`metric_durations_s`) and nested detail dicts
+are excluded — they time the BENCH, not the system.
+
+`--selftest` diffs the two committed mini-fixtures
+(scripts/bench_diff_fixture_{a,b}.json) whose B side seeds a
+throughput drop, a p99 rise, and a verdict-flag flip; each must be
+flagged BY NAME and the reverse diff must report them as
+improvements — the fixture drift gate, run from tier-1
+(tests/test_bench_cli.py).
+"""
+
+import argparse
+import json
+import math
+import os
+import sys
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+FIXTURE_A = os.path.join(_HERE, "bench_diff_fixture_a.json")
+FIXTURE_B = os.path.join(_HERE, "bench_diff_fixture_b.json")
+
+# keys that are numbers but not system metrics — never diffed
+_SKIP = {"monitor_schema_version", "baseline_batch", "serve_streams"}
+
+# explicit directions that the suffix rules below would mis-read
+_EXPLICIT = {
+    "value": +1,                      # the flagship tokens/s
+    "vs_baseline": +1,
+    "timeline_device_busy_fraction": +1,
+    "serve_pool_util": 0,             # utilization is load, not merit
+    "serve_pool_util_peak": 0,
+    "loss_scale": 0,
+}
+
+
+def metric_direction(key: str) -> int:
+    """+1 higher-is-better, -1 lower-is-better, 0 no verdict."""
+    k = key.lower()
+    if k in _EXPLICIT:
+        return _EXPLICIT[k]
+    if k.endswith(("_per_sec", "_per_chip")) or "per_sec" in k \
+            or "goodput" in k or k.endswith("mfu"):
+        return +1
+    if "recompile" in k or "overflow" in k or "skipped" in k:
+        return -1 if not k.endswith("_ok") else 0
+    if k.endswith(("_ms", "_s")):
+        return -1  # latencies, barrier/blocking seconds, p50/p99
+    if k.endswith("_fraction"):
+        # busy fraction up = the device worked more; every other
+        # fraction in the schema (drop/shed/comm/collective/host-gap)
+        # is overhead
+        return +1 if "busy" in k else -1
+    return 0
+
+
+def _numeric(v) -> bool:
+    return isinstance(v, (int, float)) and not isinstance(v, bool) \
+        and math.isfinite(v)
+
+
+def diff_metrics(old: dict, new: dict, threshold_pct: float) -> dict:
+    """The engine: per-metric rows + the regression list."""
+    rows, regressions, only = [], [], {"new": [], "gone": []}
+    for key in sorted(set(old) | set(new)):
+        if key in _SKIP:
+            continue
+        a, b = old.get(key), new.get(key)
+        if isinstance(a, bool) or isinstance(b, bool):
+            if isinstance(a, bool) and isinstance(b, bool):
+                if a == b:
+                    continue
+                verdict = "REGRESS" if (a and not b) else "IMPROVE"
+                rows.append({"metric": key, "old": a, "new": b,
+                             "delta_pct": None, "verdict": verdict})
+                if verdict == "REGRESS":
+                    regressions.append(key)
+            elif isinstance(a, bool):
+                # a verdict flag VANISHING (the gate stopped stamping)
+                # must be listed, not silently dropped — the exact
+                # truncation failure this tool exists to surface
+                only["gone"].append(key)
+            else:
+                only["new"].append(key)
+            continue
+        if not (_numeric(a) or _numeric(b)):
+            continue
+        if a is None or not _numeric(a):
+            only["new"].append(key)
+            continue
+        if b is None or not _numeric(b):
+            only["gone"].append(key)
+            continue
+        delta = b - a
+        pct = (100.0 * delta / abs(a)) if a != 0 else \
+            (0.0 if delta == 0 else math.inf)
+        direction = metric_direction(key)
+        if direction == 0:
+            verdict = "n/a"
+        elif abs(pct) <= threshold_pct:
+            verdict = "ok"
+        elif (delta > 0) == (direction > 0):
+            verdict = "IMPROVE"
+        else:
+            verdict = "REGRESS"
+        if verdict == "REGRESS":
+            regressions.append(key)
+        rows.append({"metric": key, "old": a, "new": b,
+                     "delta_pct": None if math.isinf(pct)
+                     else round(pct, 2),
+                     "verdict": verdict})
+    return {"rows": rows, "regressions": regressions,
+            "only_in_new": only["new"], "only_in_old": only["gone"],
+            "threshold_pct": threshold_pct,
+            "ok": not regressions}
+
+
+def render_diff(result: dict, label_a: str, label_b: str) -> str:
+    lines = [
+        f"=== bench diff: {label_a} -> {label_b} "
+        f"(threshold {result['threshold_pct']}%) ===",
+        "| metric                                 |        old |"
+        "        new |   delta% | verdict |",
+        "|---|---|---|---|---|",
+    ]
+
+    def fv(v):
+        if isinstance(v, bool):
+            return str(v).lower()
+        if isinstance(v, float):
+            return f"{v:.4g}"
+        return str(v)
+
+    for r in result["rows"]:
+        if r["verdict"] == "ok":
+            continue  # the interesting rows only; --json has them all
+        pct = ("" if r["delta_pct"] is None
+               else f"{r['delta_pct']:+.1f}%")
+        mark = " **" if r["verdict"] == "REGRESS" else ""
+        lines.append(
+            f"| {r['metric']:<38} | {fv(r['old']):>10} | "
+            f"{fv(r['new']):>10} | {pct:>8} | {r['verdict']}{mark} |")
+    n_ok = sum(1 for r in result["rows"] if r["verdict"] == "ok")
+    if n_ok:
+        lines.append(f"({n_ok} metric(s) within threshold not shown)")
+    if result["only_in_new"]:
+        lines.append("new metrics: " + ", ".join(result["only_in_new"]))
+    if result["only_in_old"]:
+        lines.append("gone metrics: " + ", ".join(result["only_in_old"]))
+    if result["regressions"]:
+        lines.append(f"verdict: {len(result['regressions'])} "
+                     f"REGRESSION(s): "
+                     + ", ".join(result["regressions"]))
+    else:
+        lines.append("verdict: no regression")
+    return "\n".join(lines)
+
+
+def selftest() -> int:
+    with open(FIXTURE_A) as f:
+        a = json.load(f)
+    with open(FIXTURE_B) as f:
+        b = json.load(f)
+    res = diff_metrics(a, b, threshold_pct=5.0)
+    print(render_diff(res, "fixture_a", "fixture_b"))
+    # the B side seeds exactly these, by name: a 20% throughput drop,
+    # a 50% p99 rise, and a verdict-flag flip
+    expected = {"value", "serve_p99_ms", "comms_overlap_ok"}
+    got = set(res["regressions"])
+    if not expected <= got:
+        print(f"bench_diff --selftest: seeded regression(s) not "
+              f"flagged: {sorted(expected - got)}", file=sys.stderr)
+        return 1
+    if "bert_seq_per_sec" in got:
+        print("bench_diff --selftest: the within-threshold metric was "
+              "flagged — the threshold is dead", file=sys.stderr)
+        return 1
+    # reversed, the seeded regressions must read as improvements (and
+    # the forward improvements as regressions): the judgement is
+    # direction-aware, not magnitude-only
+    rev = diff_metrics(b, a, threshold_pct=5.0)
+    improved = {r["metric"] for r in rev["rows"]
+                if r["verdict"] == "IMPROVE"}
+    if not expected <= improved:
+        print(f"bench_diff --selftest: reverse diff lost the "
+              f"improvements: {sorted(expected - improved)}",
+              file=sys.stderr)
+        return 1
+    if "serve_decode_tokens_per_sec" not in rev["regressions"]:
+        print("bench_diff --selftest: reverse diff failed to flag the "
+              "forward improvement as a regression — direction table "
+              "is asymmetric", file=sys.stderr)
+        return 1
+    print("bench_diff --selftest: OK")
+    return 0
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(
+        description="direction-aware diff of two bench JSONs")
+    ap.add_argument("old", nargs="?", help="baseline BENCH_r*.json")
+    ap.add_argument("new", nargs="?", help="candidate BENCH_r*.json")
+    ap.add_argument("--threshold", type=float, default=5.0,
+                    metavar="PCT",
+                    help="regression threshold in percent (default 5)")
+    ap.add_argument("--json", action="store_true",
+                    help="print the full machine-readable result")
+    ap.add_argument("--selftest", action="store_true",
+                    help="fixture drift gate; exit 1 when the seeded "
+                         "regressions stop being flagged")
+    args = ap.parse_args()
+    if args.selftest:
+        return selftest()
+    if not args.old or not args.new:
+        ap.error("need OLD.json and NEW.json (or --selftest)")
+
+    def load(path):
+        with open(path) as f:
+            d = json.load(f)
+        # the committed BENCH_r*.json files are driver wrappers: the
+        # bench result lives under "parsed" — unwrap so both the raw
+        # `python bench.py > out.json` form and the wrapper diff
+        if isinstance(d.get("parsed"), dict) and "value" not in d:
+            d = d["parsed"]
+        return d
+
+    old, new = load(args.old), load(args.new)
+    res = diff_metrics(old, new, threshold_pct=args.threshold)
+    if args.json:
+        print(json.dumps(res))
+    else:
+        print(render_diff(res, os.path.basename(args.old),
+                          os.path.basename(args.new)))
+    return 0 if res["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

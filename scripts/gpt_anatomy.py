@@ -39,6 +39,9 @@ Usage:
   python scripts/gpt_anatomy.py comms [targets...]         # collective inventory +
                                                            # overlap + ICI roofline
                                                            # (compile only, no execute)
+  python scripts/gpt_anatomy.py timeline [targets...]      # MEASURED step anatomy from
+                                                           # a profiler capture (executes
+                                                           # 3 steady steps)
 
 `tune` drives apex_tpu.tune.search over each target's flash shape (and
 the flat-Adam block at the 1B point), writes the winners to the
@@ -622,6 +625,58 @@ def comms_mode(targets):
     return rc
 
 
+def timeline_mode(targets, n_steps=3):
+    """Measured per-step anatomy of each target's EXACT bench train
+    step (ISSUE 15): build via the shared builder (comms-style mesh —
+    all devices, so the collective lanes are populated), EXECUTE two
+    warmup + `n_steps` captured steps under a `ProfileCapture`, and
+    print the timeline table `monitor.timeline` parses out of the
+    trace — device-busy fraction, host gap, category attribution, and
+    (on TPU) the measured per-collective overlap.  Nonzero exit when
+    the trace parsed to zero device events or the step count drifted;
+    `scripts/timeline_probe.py` is the richer CI gate (adds the ZeRO-2
+    dp target, the comms crosscheck, and --selftest)."""
+    import tempfile
+
+    import jax.numpy as jnp
+
+    from apex_tpu import monitor
+    from apex_tpu.parallel import mesh as M
+
+    on_tpu = jax.default_backend() not in ("cpu",)
+    rc = 0
+    for t in targets:
+        label, step, (opt_state, tokens, labels), _ = \
+            _build_bench_step(t, on_tpu, mode="comms")
+        tok = jnp.zeros(tokens.shape, tokens.dtype)
+        lab = jnp.zeros(labels.shape, labels.dtype)
+        state = opt_state
+        # two warmups absorb the compile + the donated-layout second
+        # compile (the bench.py rule) so the capture holds STEADY steps
+        for _ in range(2):
+            state, loss = step(state, tok, lab)
+        jax.block_until_ready(state)
+        cap = monitor.profile_capture(
+            range(n_steps),
+            logdir=tempfile.mkdtemp(prefix="anatomy_timeline_"))
+        try:
+            for i in range(n_steps):
+                with cap.step(i):
+                    state, loss = step(state, tok, lab)
+                    jax.block_until_ready(loss)
+        finally:
+            cap.close()  # a raise mid-capture must stop the profiler
+        rep = monitor.analyze_trace(cap.trace_path())
+        print(f"\n--- timeline {label} ({n_steps} measured steps)",
+              flush=True)
+        print(monitor.render_timeline_table(rep, label=label),
+              flush=True)
+        if rep.n_device_events == 0 or len(rep.steps) != n_steps:
+            rc = 1
+        M.destroy_model_parallel()
+    return rc
+
+
 CONFIGS = {
     # name: (hidden, layers, heads, batch, seq, vocab, causal)
     "350m": ("GPT-350M", 1024, 24, 16, 12, 1024, 50304, True),
@@ -674,6 +729,13 @@ if __name__ == "__main__":
             sys.exit(f"unknown comms target(s) {bad}; "
                      f"choices: {sorted(CONFIGS)}")
         sys.exit(comms_mode(targets))
+    elif which == "timeline":
+        targets = sys.argv[2:] or ["350m"]
+        bad = [t for t in targets if t not in CONFIGS]
+        if bad:
+            sys.exit(f"unknown timeline target(s) {bad}; "
+                     f"choices: {sorted(CONFIGS)}")
+        sys.exit(timeline_mode(targets))
     elif which == "blocks":
         flash_block_sweep(causal=False)   # BERT shape
         flash_block_sweep(batch=7, heads=32, seq=512, causal=True)  # 1.3B
@@ -689,4 +751,5 @@ if __name__ == "__main__":
         sys.exit(f"unknown mode {which!r}; expected one of "
                  f"{sorted(CONFIGS)} | both | roofline [target...] | "
                  "blocks | tune [--check] [target...] | mem [target...]"
-                 " | lint [target...] | comms [target...]")
+                 " | lint [target...] | comms [target...] | "
+                 "timeline [target...]")

@@ -296,6 +296,70 @@ def test_profile_capture_close_is_safety_net(tmp_path):
     assert cap.active  # window still open (last step not reached)
     cap.close()
     assert not cap.active
+    cap.close()  # idempotent — the hardening contract (ISSUE 15)
+    assert not cap.active
+
+
+def test_profile_capture_trace_path(tmp_path):
+    """ISSUE 15 satellite: trace_path() is None until a window fired,
+    then resolves the newest trace.json.gz the profiler wrote — the
+    handle `monitor.analyze_trace` composes with."""
+    logdir = str(tmp_path / "trace")
+    cap = monitor.profile_capture(range(1, 3), logdir=logdir)
+    assert cap.trace_path() is None  # nothing armed yet
+    for i in range(4):
+        with cap.step(i):
+            jnp.ones((4, 4)).sum().block_until_ready()
+    assert not cap.active
+    path = cap.trace_path()
+    assert path is not None and path.endswith(".trace.json.gz")
+    assert path.startswith(logdir)
+    rep = monitor.analyze_trace(path)  # the composed workflow parses
+    assert rep.n_events > 0
+    # a capture whose window the loop never reached stays None
+    cap2 = monitor.profile_capture(range(50, 52),
+                                   logdir=str(tmp_path / "t2"))
+    for i in range(3):
+        with cap2.step(i):
+            pass
+    cap2.close()
+    assert cap2.trace_path() is None
+
+
+def test_profile_capture_step_reentry_raises(tmp_path):
+    """ISSUE 15 satellite: re-entering step() while a trace window is
+    open raises the NAMED error (nested scopes would make every trace
+    "step" the hull of its children); outside a window the nesting is
+    inert and stays permitted."""
+    cap = monitor.profile_capture([0, 1], logdir=str(tmp_path / "t"))
+    with pytest.raises(monitor.ProfileStepReentryError,
+                       match="still open"):
+        with cap.step(0):
+            with cap.step(1):
+                pass
+    cap.close()
+    # no window armed -> nesting emits no annotations, no error
+    inert = monitor.ProfileCapture(())
+    with inert.step(0):
+        with inert.step(1):
+            pass
+    # review fix: a nested scope entered BEFORE the window opens is
+    # inert too — it must neither arm the trace nested nor reset the
+    # guard for its still-open outer scope
+    cap2 = monitor.profile_capture(range(1, 3),
+                                   logdir=str(tmp_path / "t3"))
+    with cap2.step(0):            # pre-window outer scope
+        with cap2.step(1):        # in-window but NESTED: stays inert
+            pass
+        assert not cap2.active    # the window did not open nested
+    # ...and the inert nesting did not defeat the guard: at top level
+    # the same step DOES arm the trace, and re-entry then raises
+    with cap2.step(1):
+        assert cap2.active
+        with pytest.raises(monitor.ProfileStepReentryError):
+            with cap2.step(2):
+                pass
+    cap2.close()
 
 
 # ------------------------------ hot-path wiring ------------------------------
