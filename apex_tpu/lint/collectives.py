@@ -37,6 +37,19 @@ plain graph checks:
          dp-riding exchanges only: all_to_alls over other axes (the
          Ulysses context-parallel head-scatter) are legitimate
          non-expert traffic even on an ep-carrying mesh.
+  CL207  a `ppermute` whose permutation is not a bijection on its
+         participant set.  `lax.ppermute` fills ranks that RECEIVE
+         from nobody with ZEROS — no error, no warning — so a perm
+         with duplicate sources/destinations or with
+         set(srcs) != set(dsts) silently zeroes shards on the
+         non-receiving ranks.  The chunked ring-overlap pipelines
+         (parallel/overlap.py, ISSUE 18) spell chunk-count-many
+         ppermutes per ring hop; one malformed hop zero-fills a
+         chunk of activations and the loss still goes down.  The
+         check is intra-perm only (LintConfig carries axis NAMES,
+         not sizes, so a symmetric proper-subset ring over fewer
+         ranks than the axis holds is out of reach here — the comms
+         observatory's replica-group crosscheck covers that plane).
 """
 
 from __future__ import annotations
@@ -168,6 +181,40 @@ def run(views, *, program: str, config: E.LintConfig) -> List[Finding]:
                              "exchange, or allowlist if this "
                              "all_to_all is deliberately non-expert "
                              "traffic"))
+
+            # ---- CL207: non-bijective ppermute (silent zero-fill) ----
+            # lax.ppermute zero-fills every rank the perm does not
+            # name as a destination — so anything short of a bijection
+            # on the participant set loses data without an error.
+            if prim == "ppermute":
+                perm = tuple(eqn.params.get("perm") or ())
+                srcs = [s for s, _ in perm]
+                dsts = [d for _, d in perm]
+                dup_src = len(srcs) != len(set(srcs))
+                dup_dst = len(dsts) != len(set(dsts))
+                if dup_src or dup_dst:
+                    findings.append(make_finding(
+                        "CL207", loc,
+                        "ppermute perm has duplicate "
+                        f"{'sources' if dup_src else 'destinations'} — "
+                        "the permutation is not a bijection and the "
+                        "exchange is ill-defined",
+                        hint="each rank may appear at most once as "
+                             "source and once as destination; a ring "
+                             "hop is [(i, (i+shift) % n) for i in "
+                             "range(n)]"))
+                elif set(srcs) != set(dsts):
+                    missing = sorted(set(srcs) - set(dsts))
+                    findings.append(make_finding(
+                        "CL207", loc,
+                        f"ppermute perm sends from ranks {missing} that "
+                        "receive from nobody — lax.ppermute fills "
+                        "non-receiving ranks with ZEROS, silently "
+                        "dropping their shard from the exchange",
+                        hint="close the ring (every sender must also "
+                             "receive) or allowlist if the zero-fill "
+                             "is deliberate (one-directional halo "
+                             "edge)"))
 
             # ---- CL202: psum-of-psum ----
             if prim == "psum":

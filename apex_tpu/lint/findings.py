@@ -45,6 +45,7 @@ RULES = {
     "CL204": ("warning", "fp16 psum operand can overflow under loss scaling"),
     "CL205": ("warning", "dead collective (result unused)"),
     "CL206": ("error", "all_to_all over an unbound/mismatched ep axis"),
+    "CL207": ("error", "non-bijective ppermute perm (silent zero-fill)"),
     # donation
     "DN301": ("warning", "state argument not covered by donate_argnums"),
     "DN302": ("error", "runtime donation failed (CompileReport.donation_ok)"),

@@ -88,6 +88,13 @@ class GPTConfig:
     attn_block_q: Any = None
     attn_block_k: Any = None
     attn_heads_per_step: Any = None
+    # Chunked compute/collective overlap depth for the TP layers
+    # (parallel/overlap.py) and the MoE micro-chunk exchange.  None =
+    # tuner-owned (`overlap_chunks` op, heuristic 1 — the monolithic
+    # pre-overlap program, byte-identical on untuned machines); an int
+    # forces the pipeline depth for A/B sweeps (non-dividing requests
+    # fall back to the largest dividing count, warn once).
+    overlap_chunks: Any = None
     remat: bool = False            # activation checkpointing per block
     # What the per-block checkpoint may keep (≡ the reference's partial /
     # selective activation checkpointing, fwd_bwd_pipelining_without_
@@ -127,21 +134,25 @@ class GPT:
             qkv = ColumnParallelLinear(
                 c.hidden, 3 * c.hidden, gather_output=False,
                 sequence_parallel=c.sequence_parallel,
-                axis_name=c.axis_name, init_std=0.02)
+                axis_name=c.axis_name, init_std=0.02,
+                overlap_chunks=c.overlap_chunks)
             proj = RowParallelLinear(
                 c.hidden, c.hidden, input_is_parallel=True,
                 sequence_parallel=c.sequence_parallel,
                 axis_name=c.axis_name,
-                init_std=0.02 / jnp.sqrt(2.0 * c.num_layers))
+                init_std=0.02 / jnp.sqrt(2.0 * c.num_layers),
+                overlap_chunks=c.overlap_chunks)
             fc1 = ColumnParallelLinear(
                 c.hidden, c.ffn_mult * c.hidden, gather_output=False,
                 sequence_parallel=c.sequence_parallel,
-                axis_name=c.axis_name, init_std=0.02)
+                axis_name=c.axis_name, init_std=0.02,
+                overlap_chunks=c.overlap_chunks)
             fc2 = RowParallelLinear(
                 c.ffn_mult * c.hidden, c.hidden, input_is_parallel=True,
                 sequence_parallel=c.sequence_parallel,
                 axis_name=c.axis_name,
-                init_std=0.02 / jnp.sqrt(2.0 * c.num_layers))
+                init_std=0.02 / jnp.sqrt(2.0 * c.num_layers),
+                overlap_chunks=c.overlap_chunks)
             self.blocks.append((qkv, proj, fc1, fc2))
 
     # ------------------------------ params --------------------------------

@@ -91,7 +91,8 @@ class MoEGPT(GPT):
                    proj_init_std=0.02 / float(jnp.sqrt(
                        2.0 * c.num_layers)),
                    router_block_rows=c.router_block_rows or None,
-                   tp_axis=c.axis_name)
+                   tp_axis=c.axis_name,
+                   overlap_chunks=c.overlap_chunks)
             for _ in range(c.num_layers)]
 
     # ------------------------------ params --------------------------------

@@ -95,3 +95,48 @@ def exchange_combine(y, ep_axis, ep_size: int, n_experts: int,
                            tiled=True)
     flat = y.reshape(n_experts * capacity, h)
     return jnp.concatenate([flat, jnp.zeros((1, h), flat.dtype)], axis=0)
+
+
+def chunked_expert_exchange(buf, ffn, ep_axis, ep_size: int,
+                            n_experts: int, capacity: int,
+                            chunks: int = 1) -> jnp.ndarray:
+    """dispatch-exchange -> expert FFN -> combine-exchange, micro-
+    chunked along the capacity dim (ISSUE 18): the dispatch
+    all_to_all of chunk k+1 and the combine all_to_all of chunk k-1
+    both ride ICI while the expert FFN chews chunk k.
+
+    `ffn(xe)` maps (E_loc, rows, H) -> (E_loc, rows, H) and must be
+    ROW-INDEPENDENT along the slot dim (MoEMLP._expert_ffn is: the
+    einsum contracts hidden dims only) — that is what makes each
+    chunk's rows bitwise the rows of the monolithic exchange, and the
+    concatenation an exact reassembly.  Slot chunk j of every expert
+    travels together, so each chunk's exchange is the same tiled
+    all_to_all pattern at capacity/chunks rows — chunk-count-many
+    smaller collectives, same total bytes (the comms-fixture pin).
+
+    chunks == 1 is EXACTLY the monolithic exchange_dispatch -> ffn ->
+    exchange_combine sequence (byte-identical trace, the
+    RecompileSentry anchor).  AD needs no custom_vjp: all_to_all
+    transposes to its inverse per chunk, and the ffn's parameter
+    grads sum across the chunk calls automatically."""
+    if chunks <= 1:
+        xe = exchange_dispatch(buf, ep_axis, ep_size, n_experts, capacity)
+        ye = ffn(xe)
+        return exchange_combine(ye, ep_axis, ep_size, n_experts, capacity)
+    h = buf.shape[1]
+    ebuf = buf[:n_experts * capacity].reshape(n_experts, capacity, h)
+    cc = capacity // chunks
+    outs = []
+    for j in range(chunks):
+        piece = lax.slice_in_dim(ebuf, j * cc, (j + 1) * cc, axis=1)
+        if ep_size > 1:
+            piece = lax.all_to_all(piece, ep_axis, split_axis=0,
+                                   concat_axis=1, tiled=True)
+        ye = ffn(piece)  # (E_loc, ep*cc, H), rows independent
+        if ep_size > 1:
+            ye = lax.all_to_all(ye, ep_axis, split_axis=1,
+                                concat_axis=0, tiled=True)
+        outs.append(ye)
+    y = jnp.concatenate(outs, axis=1)  # (E, capacity, H), slot order
+    flat = y.reshape(n_experts * capacity, h)
+    return jnp.concatenate([flat, jnp.zeros((1, h), flat.dtype)], axis=0)

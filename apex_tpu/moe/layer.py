@@ -74,7 +74,8 @@ class MoEMLP:
                  init_std: float = 0.02,
                  proj_init_std: Optional[float] = None,
                  router_block_rows: Optional[int] = None,
-                 tp_axis: Optional[str] = None):
+                 tp_axis: Optional[str] = None,
+                 overlap_chunks=None):
         if n_experts % max(1, ep_size):
             raise ValueError(
                 f"n_experts={n_experts} must divide by ep_size={ep_size}")
@@ -99,6 +100,11 @@ class MoEMLP:
         # by tp, so apply() raises at trace time when the bound tp
         # axis has size > 1).
         self.tp_axis = tp_axis
+        # micro-chunk depth of the dispatch/combine exchange
+        # (dispatch.chunked_expert_exchange): None = tuner-owned
+        # (`overlap_chunks` op, heuristic 1 = the monolithic exchange,
+        # byte-identical); an int forces it for A/B sweeps.
+        self.overlap_chunks = overlap_chunks
 
     # ------------------------------ params --------------------------------
 
@@ -164,6 +170,23 @@ class MoEMLP:
         if cn:
             y = checkpoint_name(y, cn[1])
         return y
+
+    def _exchange_chunks(self, capacity: int, dtype) -> int:
+        """Trace-time micro-chunk count for the ep exchange: explicit
+        override, else the `overlap_chunks` tuner op (heuristic 1 on a
+        miss).  Non-dividing requests fall back to the largest divisor
+        of the capacity, warn once (the flash-attention block rule)."""
+        from apex_tpu.parallel import overlap as OV
+        req = self.overlap_chunks
+        if req is None:
+            from apex_tpu import tune
+            cfg = tune.tuned("overlap_chunks", tune.overlap_attrs(
+                "moe", capacity, self.hidden, self.ep_size, dtype))
+            req = int(cfg["chunks"]) if cfg else 1
+        req = int(req)
+        if req <= 1:
+            return 1
+        return OV.resolve_chunks(req, capacity, site="moe")
 
     def apply(self, params, x, tap_prefix: Optional[str] = None,
               cn=None):
@@ -239,11 +262,13 @@ class MoEMLP:
         else:
             dest, dropped = R.capacity_destinations(out.idx, e, cap)
             buf = D.dispatch(xt, dest, e, cap)
-            xe = D.exchange_dispatch(buf, self.ep_axis, self.ep_size, e,
-                                     cap)
-            ye = self._expert_ffn(params, xe, cn=cn)
-            ybuf = D.exchange_combine(ye, self.ep_axis, self.ep_size, e,
-                                      cap)
+            # micro-chunked exchange (ISSUE 18): chunk k+1's dispatch
+            # all_to_all overlaps chunk k's expert FFN; chunks == 1 is
+            # the monolithic sequence, byte-identical
+            chunks = self._exchange_chunks(cap, xt.dtype)
+            ybuf = D.chunked_expert_exchange(
+                buf, lambda xe: self._expert_ffn(params, xe, cn=cn),
+                self.ep_axis, self.ep_size, e, cap, chunks)
             y = D.combine(ybuf, dest, out.gate)
 
         aux_loss, load, _ = R.load_balancing_aux(out.probs, out.idx, e)

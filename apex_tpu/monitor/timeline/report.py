@@ -578,6 +578,22 @@ def render_timeline_table(report, label: str = "trace") -> str:
 
 # ------------------------- comms cross-check -------------------------
 
+def _coll_name_prefix(name: str, strip_start: bool = False) -> str:
+    """The HLO instruction name with its uniquifying ".N" suffix
+    stripped — the pool key the chunked same-kind instructions of one
+    logical collective share.  The async "-start" spelling is KEPT in
+    the prefix (it separates the overlapped chunked instances from a
+    sync same-kind collective elsewhere in the module — the exact
+    distinction kind-ordinal pairing loses); `strip_start=True` gives
+    the fallback spelling for a trace that records the op under its
+    base name."""
+    head, dot, tail = name.rpartition(".")
+    base = head if (dot and tail.isdigit()) else name
+    if strip_start and base.endswith("-start"):
+        base = base[:-len("-start")]
+    return base
+
+
 def crosscheck_comms(timeline, comms_report, *,
                      tolerance: float = 0.25) -> dict:
     """Close the loop between the comms observatory's PREDICTED
@@ -586,8 +602,14 @@ def crosscheck_comms(timeline, comms_report, *,
     report (group_size > 1), matched to the trace's collective spans
     by optimized-module instruction name — the trace's `args.hlo_op`
     and the comms inventory parse the SAME module, so exact-name match
-    is the common case; unmatched collectives fall back to kind-ordinal
-    pairing (k-th all-reduce ↔ k-th all-reduce span).
+    is the common case; unmatched collectives then pair within their
+    NAME-PREFIX group (the uniquifying ".N" suffix stripped, async
+    "-start" kept: the chunked-overlap pipelines of ISSUE 18 spell
+    one logical collective as chunk-count-many same-kind instructions,
+    where raw kind-ordinal pairing would judge an overlapped chunk
+    against the span of an unrelated sync same-kind collective); only
+    leftovers fall back to kind-ordinal pairing (k-th all-reduce ↔
+    k-th all-reduce span).
 
     Row verdicts: AGREE (|predicted − measured| ≤ tolerance),
     DIVERGES (the AOT model and the schedule disagree — the thing this
@@ -623,6 +645,34 @@ def crosscheck_comms(timeline, comms_report, *,
         if span is not None and id(span) not in claimed:
             claimed.add(id(span))
             span_for[i] = span
+    # pass 1.5 — NAME-PREFIX groups: a chunked program (ISSUE 18)
+    # spells one logical collective as N same-kind instructions
+    # ("collective-permute.{7..12}"); if the trace renumbered them,
+    # raw kind-ordinal pairing could hand a chunk's span to an
+    # UNRELATED same-kind collective (the dp grad all-reduce vs the
+    # tp ring hop).  Pairing inside the ".N"-stripped prefix pool
+    # first keeps chunk spans with their own logical collective.
+    spans_by_prefix: Dict[str, list] = {}
+    for s in t.get("collectives", []):
+        spans_by_prefix.setdefault(
+            _coll_name_prefix(s["name"]), []).append(s)
+    prefix_cursor: Dict[str, int] = {}
+    for i, coll in enumerate(counted):
+        if i in span_for:
+            continue
+        name = coll.get("name", "")
+        pref = _coll_name_prefix(name)
+        if pref not in spans_by_prefix:
+            # trace recorded the base-name spelling of an async op
+            pref = _coll_name_prefix(name, strip_start=True)
+        pool = spans_by_prefix.get(pref, [])
+        j = prefix_cursor.get(pref, 0)
+        while j < len(pool) and id(pool[j]) in claimed:
+            j += 1
+        if j < len(pool):
+            claimed.add(id(pool[j]))
+            span_for[i] = pool[j]
+            prefix_cursor[pref] = j + 1
     kind_cursor: Dict[str, int] = {}
     for i, coll in enumerate(counted):
         if i in span_for:

@@ -1,0 +1,350 @@
+"""Chunked compute/collective overlap primitives (ISSUE 18 tentpole).
+
+The TP hot path's tax is a handful of BIG collectives that serialize
+against the GEMMs that produce or consume them: the column-parallel
+layer's sequence all-gather must finish before its GEMM starts, and
+the row-parallel reduce-scatter/all-reduce can't start until its GEMM
+finishes.  T3 (arXiv 2401.16677) and partially-synchronized
+activations (arXiv 2506.19645) show the cure: split the work along
+the batch/sequence dim into `chunks` pieces and software-pipeline —
+the collective for chunk k+1 is in flight on ICI while the MXU chews
+chunk k.  XLA's async collectives do the actual overlapping; these
+primitives just give the scheduler chunk-granular pieces it CAN
+overlap (one monolithic dependency edge offers nothing to reorder).
+
+Four fused matmul+collective spellings, one per TP layer shape:
+
+  ring_gather_matmul    column-parallel + sequence_parallel: the
+                        all-gather+GEMM becomes p-1 per-chunk
+                        `ppermute` ring steps (collectives.ring_
+                        exchange) interleaved with partial GEMMs —
+                        bytes drop to (p-1)/p of the all-gather and
+                        every hop hides behind a GEMM.
+  matmul_reduce_scatter row-parallel + sequence_parallel: the down
+                        projection runs chunk-by-chunk along the
+                        OUTPUT sequence rows; chunk k's psum_scatter
+                        overlaps chunk k+1's GEMM.
+  matmul_all_reduce     row-parallel, no SP: same pipeline with psum.
+  copy_matmul           column-parallel, no SP: forward is the plain
+                        local GEMM (no collective to hide); backward
+                        chunks the dgrad GEMM against the copy_to
+                        psum of dx.
+
+All four are `jax.custom_vjp` (like parallel/collectives.py's region
+pairs) so the BACKWARD is pipelined too — AD of a hand-unrolled ring
+would otherwise serialize the transposed collectives.  GEMMs
+accumulate in fp32 on the MXU (`preferred_element_type`) and weight
+grads accumulate across chunks/ring-steps in fp32, so chunked results
+are allclose to the monolithic spelling at tight tolerance; the
+chunks==1 case is NOT routed here at all — callers keep their
+original monolithic code path, byte-identical to pre-overlap
+programs (the RecompileSentry anchor).
+
+Chunk counts are tuner-owned: `tune.tuned("overlap_chunks",
+tune.overlap_attrs(...))`, heuristic 1 on a miss — CPU and untuned
+machines trace exactly the pre-PR program.  `resolve_chunks` applies
+the flash-attention block rule to non-dividing requests: fall back to
+the largest dividing count and warn once per call site.
+"""
+
+from __future__ import annotations
+
+import functools
+import warnings
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from apex_tpu.parallel.collectives import ring_exchange
+
+# call sites that already warned about a non-dividing chunk request —
+# warn once per (site, requested, dim), not once per trace
+_WARNED_SITES = set()
+
+
+def resolve_chunks(requested: int, dim: int, site: str = "overlap") -> int:
+    """Largest divisor of `dim` that is <= `requested` (>= 1).
+
+    The flash-attention block rule: a tuned/forced chunk count that
+    does not divide the chunked dim must not crash the trace NOR
+    silently change semantics — fall back to the largest dividing
+    count and warn once per call site."""
+    requested = int(requested)
+    dim = int(dim)
+    if requested <= 1 or dim <= 1:
+        return 1
+    c = min(requested, dim)
+    while dim % c:
+        c -= 1
+    if c != requested:
+        key = (site, requested, dim)
+        if key not in _WARNED_SITES:
+            _WARNED_SITES.add(key)
+            warnings.warn(
+                f"overlap_chunks={requested} does not divide the "
+                f"chunked dim ({dim}) at {site!r}; falling back to "
+                f"{c} chunks", stacklevel=2)
+    return c
+
+
+def layer_chunks(requested, path: str, rows: int, width: int,
+                 axis_name: str, dtype, divisor_of: int) -> int:
+    """Trace-time chunk-count decision for one TP layer call site.
+
+    requested None = tuner-owned: consult the `overlap_chunks` cache
+    keyed by tune.overlap_attrs (per device kind); heuristic 1 on a
+    miss, so untuned paths stay byte-identical to pre-overlap
+    programs.  An explicit int is the A/B override and still goes
+    through `resolve_chunks` (the non-dividing fallback)."""
+    if requested is None:
+        from apex_tpu import tune
+        try:
+            p = int(lax.axis_size(axis_name))
+        except NameError:
+            p = 1
+        cfg = tune.tuned("overlap_chunks",
+                         tune.overlap_attrs(path, rows, width, p, dtype))
+        requested = int(cfg["chunks"]) if cfg else 1
+    requested = int(requested)
+    if requested <= 1:
+        return 1
+    return resolve_chunks(requested, divisor_of, site=path)
+
+
+def _dot(a, b, out_dtype):
+    """The house GEMM spelling: fp32 MXU accumulation, cast back."""
+    return jnp.dot(a, b, preferred_element_type=jnp.float32).astype(out_dtype)
+
+
+def _flat_wgrad(x_rows, g_rows):
+    """fp32 (H, O) partial weight grad from matching row blocks."""
+    xm = x_rows.reshape(-1, x_rows.shape[-1])
+    gm = g_rows.reshape(-1, g_rows.shape[-1])
+    return jnp.einsum("th,to->ho", xm, gm,
+                      preferred_element_type=jnp.float32)
+
+
+# --------------------------------------------------------------------------
+# column-parallel + sequence_parallel: ppermute-ring gather + GEMM
+# --------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def ring_gather_matmul(x, w, axis_name, chunks):
+    """all_gather(x, dim 0) @ w, as a chunked ppermute ring.
+
+    x: (s_loc, ..., H) this rank's sequence shard; w: (H, O_loc).
+    Returns (p*s_loc, ..., O_loc) — the full-sequence activation
+    against the local weight shard, bitwise the same rows as the
+    monolithic gather+GEMM (each row is one fp32-accumulated dot).
+
+    Ring step k holds source shard (r+k) mod p; the ppermutes feeding
+    step k+1 are issued BEFORE step k's GEMMs so XLA overlaps the hop
+    with the math.  `chunks` sub-slices each shard so each hop is a
+    smaller, earlier-available piece.  Total bytes: (p-1)/p of the
+    all-gather."""
+    return _ring_fwd_impl(x, w, axis_name, chunks)
+
+
+def _ring_fwd_impl(x, w, ax, chunks):
+    p = lax.axis_size(ax)
+    r = lax.axis_index(ax)
+    s = x.shape[0]
+    sc = s // chunks
+    out = jnp.zeros((p * s,) + x.shape[1:-1] + (w.shape[-1],), x.dtype)
+    held = [lax.slice_in_dim(x, j * sc, (j + 1) * sc, axis=0)
+            for j in range(chunks)]
+    for k in range(p):
+        src = (r + k) % p  # traced rank -> dynamic row placement
+        nxt = []
+        for j in range(chunks):
+            if k + 1 < p:
+                # issue the hop for step k+1 before this chunk's GEMM
+                nxt.append(ring_exchange(held[j], ax, shift=-1))
+            y = _dot(held[j], w, x.dtype)
+            out = lax.dynamic_update_slice_in_dim(
+                out, y, src * s + j * sc, axis=0)
+        if nxt:
+            held = nxt
+    return out
+
+
+def _ring_fwd(x, w, ax, chunks):
+    return _ring_fwd_impl(x, w, ax, chunks), (x, w)
+
+
+def _ring_bwd(ax, chunks, res, g):
+    x, w = res
+    p = lax.axis_size(ax)
+    r = lax.axis_index(ax)
+    s = x.shape[0]
+    sc = s // chunks
+    # dx = reduce_scatter(g @ w^T, dim 0) — the gather's transpose —
+    # chunked so chunk k's psum_scatter overlaps chunk k+1's GEMM.
+    # Rows regroup as (p, chunks, sc): the scatter keeps rank-block r
+    # of each chunk, i.e. this shard's rows [j*sc, (j+1)*sc).
+    gv = g.reshape((p, chunks, sc) + g.shape[1:])
+    dx_chunks = []
+    for j in range(chunks):
+        gj = gv[:, j].reshape((p * sc,) + g.shape[1:])
+        z = _dot(gj, w.T, x.dtype)
+        dx_chunks.append(
+            lax.psum_scatter(z, ax, scatter_dimension=0, tiled=True))
+    dx = jnp.concatenate(dx_chunks, axis=0)
+    # dw: ring over x again — every rank sees every source shard and
+    # each rank's g is the FULL (p*s, ...) cotangent of its local
+    # output columns, so the fp32 accumulation is complete with NO
+    # trailing psum (the ring IS the reduction's data movement).
+    dw = jnp.zeros(w.shape, jnp.float32)
+    held = [lax.slice_in_dim(x, j * sc, (j + 1) * sc, axis=0)
+            for j in range(chunks)]
+    for k in range(p):
+        src = (r + k) % p
+        nxt = []
+        for j in range(chunks):
+            if k + 1 < p:
+                nxt.append(ring_exchange(held[j], ax, shift=-1))
+            g_rows = lax.dynamic_slice_in_dim(
+                g, src * s + j * sc, sc, axis=0)
+            dw = dw + _flat_wgrad(held[j], g_rows)
+        if nxt:
+            held = nxt
+    return dx, dw.astype(w.dtype)
+
+
+ring_gather_matmul.defvjp(_ring_fwd, _ring_bwd)
+
+
+# --------------------------------------------------------------------------
+# row-parallel + sequence_parallel: GEMM + chunked reduce-scatter
+# --------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def matmul_reduce_scatter(x, w, axis_name, chunks):
+    """reduce_scatter(x @ w, dim 0), chunked along the OUTPUT rows.
+
+    x: (S, ..., H_loc); w: (H_loc, O).  Returns (S/p, ..., O).  Each
+    chunk GEMMs exactly the input rows that feed its output slice
+    (rank-block-strided, values identical row-for-row) and scatters
+    them while the next chunk's GEMM runs."""
+    return _mrs_fwd_impl(x, w, axis_name, chunks)
+
+
+def _mrs_fwd_impl(x, w, ax, chunks):
+    p = lax.axis_size(ax)
+    s = x.shape[0]
+    so = s // p
+    soc = so // chunks
+    xv = x.reshape((p, so) + x.shape[1:])
+    outs = []
+    for j in range(chunks):
+        xj = lax.slice_in_dim(xv, j * soc, (j + 1) * soc, axis=1)
+        xj = xj.reshape((p * soc,) + x.shape[1:])
+        z = _dot(xj, w, x.dtype)
+        outs.append(
+            lax.psum_scatter(z, ax, scatter_dimension=0, tiled=True))
+    return jnp.concatenate(outs, axis=0)
+
+
+def _mrs_fwd(x, w, ax, chunks):
+    return _mrs_fwd_impl(x, w, ax, chunks), (x, w)
+
+
+def _mrs_bwd(ax, chunks, res, g):
+    x, w = res
+    p = lax.axis_size(ax)
+    s = x.shape[0]
+    so = s // p
+    soc = so // chunks
+    xv = x.reshape((p, so) + x.shape[1:])
+    dw = jnp.zeros(w.shape, jnp.float32)
+    dx_parts = []
+    for j in range(chunks):
+        gj = lax.slice_in_dim(g, j * soc, (j + 1) * soc, axis=0)
+        # the scatter's transpose: all-gather this output chunk's
+        # cotangent, chunk k's gather overlaps chunk k-1's dgrad GEMM
+        G = lax.all_gather(gj, ax, axis=0, tiled=True)  # (p*soc, ..., O)
+        dxj = _dot(G, w.T, x.dtype)
+        dx_parts.append(dxj.reshape((p, soc) + dxj.shape[1:]))
+        xj = lax.slice_in_dim(xv, j * soc, (j + 1) * soc, axis=1)
+        dw = dw + _flat_wgrad(xj, G.reshape((p, soc) + G.shape[1:]))
+    # (p, chunks, soc, ...) -> (S, ...): row (q, j, i) is input row
+    # q*so + j*soc + i, the inverse of the forward's regrouping
+    dx = jnp.stack(dx_parts, axis=1).reshape((s,) + x.shape[1:])
+    return dx, dw.astype(w.dtype)
+
+
+matmul_reduce_scatter.defvjp(_mrs_fwd, _mrs_bwd)
+
+
+# --------------------------------------------------------------------------
+# row-parallel, no SP: GEMM + chunked all-reduce
+# --------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def matmul_all_reduce(x, w, axis_name, chunks):
+    """psum(x @ w), chunked along dim 0: chunk k's all-reduce rides
+    ICI while chunk k+1's GEMM runs.  x: (S, ..., H_loc); w:
+    (H_loc, O); returns (S, ..., O) fully reduced."""
+    return _mar_fwd_impl(x, w, axis_name, chunks)
+
+
+def _mar_fwd_impl(x, w, ax, chunks):
+    s = x.shape[0]
+    sc = s // chunks
+    outs = []
+    for j in range(chunks):
+        xj = lax.slice_in_dim(x, j * sc, (j + 1) * sc, axis=0)
+        outs.append(lax.psum(_dot(xj, w, x.dtype), ax))
+    return jnp.concatenate(outs, axis=0)
+
+
+def _mar_fwd(x, w, ax, chunks):
+    return _mar_fwd_impl(x, w, ax, chunks), (x, w)
+
+
+def _mar_bwd(ax, chunks, res, g):
+    # the all-reduce's transpose is the identity (reduce_from's f/g
+    # pair): dgrad and wgrad are LOCAL — nothing to overlap, so the
+    # backward stays monolithic (chunking it would only shrink GEMMs)
+    x, w = res
+    dx = _dot(g, w.T, x.dtype)
+    dw = _flat_wgrad(x, g).astype(w.dtype)
+    return dx, dw
+
+
+matmul_all_reduce.defvjp(_mar_fwd, _mar_bwd)
+
+
+# --------------------------------------------------------------------------
+# column-parallel, no SP: plain GEMM fwd, chunked psum(dx) bwd
+# --------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def copy_matmul(x, w, axis_name, chunks):
+    """copy_to(x) @ w.  Forward is the plain local GEMM (copy_to is
+    the identity — there is no forward collective to hide); backward
+    chunks dx = psum(g @ w^T) so each chunk's all-reduce overlaps the
+    next chunk's dgrad GEMM.  x: (S, ..., H) replicated; w:
+    (H, O_loc)."""
+    return _dot(x, w, x.dtype)
+
+
+def _cm_fwd(x, w, ax, chunks):
+    return _dot(x, w, x.dtype), (x, w)
+
+
+def _cm_bwd(ax, chunks, res, g):
+    x, w = res
+    s = x.shape[0]
+    sc = s // chunks
+    dx_parts = []
+    for j in range(chunks):
+        gj = lax.slice_in_dim(g, j * sc, (j + 1) * sc, axis=0)
+        dx_parts.append(lax.psum(_dot(gj, w.T, x.dtype), ax))
+    dx = jnp.concatenate(dx_parts, axis=0)
+    dw = _flat_wgrad(x, g).astype(w.dtype)
+    return dx, dw
+
+
+copy_matmul.defvjp(_cm_fwd, _cm_bwd)

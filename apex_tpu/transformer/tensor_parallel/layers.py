@@ -47,7 +47,8 @@ class ColumnParallelLinear:
 
     def __init__(self, input_size: int, output_size: int, *, bias: bool = True,
                  gather_output: bool = False, sequence_parallel: bool = False,
-                 init_std: Optional[float] = None, axis_name: str = TP_AXIS):
+                 init_std: Optional[float] = None, axis_name: str = TP_AXIS,
+                 overlap_chunks=None):
         self.input_size = input_size
         self.output_size = output_size
         self.use_bias = bias
@@ -55,6 +56,11 @@ class ColumnParallelLinear:
         self.sequence_parallel = sequence_parallel
         self.init_std = init_std
         self.axis_name = axis_name
+        # chunked compute/collective overlap (parallel/overlap.py).
+        # None = tuner-owned (`overlap_chunks` op, heuristic 1); an int
+        # forces the pipeline depth for A/B sweeps.  chunks == 1 keeps
+        # the monolithic spelling below byte-identical to pre-overlap.
+        self.overlap_chunks = overlap_chunks
 
     def init(self, key, dtype=jnp.float32):
         std = self.init_std or (1.0 / jnp.sqrt(self.input_size))
@@ -73,6 +79,26 @@ class ColumnParallelLinear:
     def apply(self, params, x):
         """Shard-local: params are the LOCAL shards (out dim / tp)."""
         ax = self.axis_name
+        from apex_tpu.parallel import overlap as OV
+        path = "tp_col" if self.sequence_parallel else "tp_col_copy"
+        chunks = OV.layer_chunks(
+            self.overlap_chunks, path, x.shape[0],
+            params["weight"].shape[-1], ax, x.dtype,
+            divisor_of=x.shape[0])
+        if chunks > 1:
+            if self.sequence_parallel:
+                # gather+GEMM as a chunked ppermute ring: each hop
+                # hides behind the previous chunk's partial GEMM
+                y = OV.ring_gather_matmul(x, params["weight"], ax, chunks)
+            else:
+                # no forward collective to hide; the fused primitive
+                # chunks the BACKWARD dx psum against the dgrad GEMM
+                y = OV.copy_matmul(x, params["weight"], ax, chunks)
+            if self.use_bias:
+                y = y + params["bias"].astype(y.dtype)
+            if self.gather_output:
+                y = gather_from_tensor_model_parallel_region(y, ax)
+            return y
         if self.sequence_parallel:
             x = gather_from_sequence_parallel_region(x, ax)
         else:
@@ -98,7 +124,8 @@ class RowParallelLinear:
     def __init__(self, input_size: int, output_size: int, *, bias: bool = True,
                  input_is_parallel: bool = True,
                  sequence_parallel: bool = False,
-                 init_std: Optional[float] = None, axis_name: str = TP_AXIS):
+                 init_std: Optional[float] = None, axis_name: str = TP_AXIS,
+                 overlap_chunks=None):
         if sequence_parallel and not input_is_parallel:
             raise RuntimeError(
                 "To enable sequence_parallel, input_is_parallel must be True")
@@ -109,6 +136,8 @@ class RowParallelLinear:
         self.sequence_parallel = sequence_parallel
         self.init_std = init_std
         self.axis_name = axis_name
+        # chunked GEMM+reduce pipeline depth — see ColumnParallelLinear
+        self.overlap_chunks = overlap_chunks
 
     def init(self, key, dtype=jnp.float32):
         std = self.init_std or (1.0 / jnp.sqrt(self.input_size))
@@ -130,6 +159,34 @@ class RowParallelLinear:
             from apex_tpu.parallel.collectives import (
                 scatter_to_tensor_model_parallel_region)
             x = scatter_to_tensor_model_parallel_region(x, ax)
+        from apex_tpu.parallel import overlap as OV
+        if self.sequence_parallel:
+            try:
+                p = int(lax.axis_size(ax))
+            except NameError:
+                p = 1
+            # the chunked dim is the OUTPUT rows (S/p): each chunk
+            # GEMMs the input rows feeding its scatter slice
+            div = x.shape[0] // max(1, p)
+            path = "tp_row"
+        else:
+            div = x.shape[0]
+            path = "tp_row_ar"
+        chunks = OV.layer_chunks(
+            self.overlap_chunks, path, x.shape[0],
+            params["weight"].shape[-1], ax, x.dtype, divisor_of=div)
+        if chunks > 1:
+            if self.sequence_parallel:
+                y = OV.matmul_reduce_scatter(x, params["weight"], ax,
+                                             chunks)
+            else:
+                y = OV.matmul_all_reduce(x, params["weight"], ax, chunks)
+            if self.use_bias:
+                bias = params["bias"]
+                if self.sequence_parallel:
+                    bias = copy_to_tensor_model_parallel_region(bias, ax)
+                y = y + bias.astype(y.dtype)
+            return y
         y = jnp.dot(x, params["weight"],
                     preferred_element_type=jnp.float32).astype(x.dtype)
         if self.sequence_parallel:

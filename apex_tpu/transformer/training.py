@@ -7,6 +7,14 @@ step — shard-local forward/backward with TP collectives inside autodiff,
 dp-pmean of grads, fused optimizer on the LOCAL param shard (each rank
 owns and updates exactly its shard — optimizer state is tp-sharded by
 construction, which is also the natural ZeRO-over-tp layout).
+
+Chunked compute/collective overlap (ISSUE 18) rides through here
+untouched: `GPTConfig.overlap_chunks` reaches the TP layers at model
+construction, so the step this builder jits contains the chunked
+ppermute-ring / chunked-reduce pipelines (parallel/overlap.py) in
+BOTH directions — the custom_vjp spellings keep the backward chunked
+under the value_and_grad below, and at chunks == 1 the traced program
+is byte-identical to the pre-overlap step.
 """
 
 from __future__ import annotations

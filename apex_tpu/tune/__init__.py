@@ -27,6 +27,15 @@ one knob tunes both the DMA unit and the pool granularity), and the
 MoE top-k router's row block (`moe_router`, key: moe_router_attrs —
 softmax + top-k are row-independent, so the tuned blocked path is
 byte-identical to the dense reference at every block size).
+
+ISSUE 18 adds the `overlap_chunks` op (key: overlap_attrs): the chunk
+count of the TP layers' fused matmul+collective pipelines and the MoE
+dispatch/combine micro-chunking (parallel/overlap.py,
+moe/dispatch.chunked_expert_exchange).  Heuristic 1 on a miss = the
+monolithic pre-overlap program, byte-identical — chunks > 1 is a
+measured-win-only setting (per device kind), because each extra chunk
+pays a collective launch latency floor that only a hardware sweep can
+price against the hidden bandwidth (docs/PERF.md "Chunked overlap").
 """
 
 from apex_tpu.tune.cache import (  # noqa: F401
@@ -110,6 +119,25 @@ def serve_page_attrs(n_kv_heads, head_dim, dtype):
     dtype = jnp.bfloat16 if dtype is None else dtype
     return dict(hkv=int(n_kv_heads), d=int(head_dim),
                 dtype=jnp.dtype(dtype).name)
+
+
+def overlap_attrs(path, rows, width, axis_size, dtype):
+    """The ONE definition of the `overlap_chunks` lookup-key attrs —
+    shared by the runtime lookups (parallel/overlap.layer_chunks,
+    moe/layer.MoEMLP) and any sweep driver.  The config carries
+    `chunks`, the pipeline depth of a fused matmul+collective site.
+    `path` names the site shape ("tp_col" ring-gather, "tp_row"
+    GEMM+reduce-scatter, "tp_row_ar" GEMM+all-reduce, "tp_col_copy"
+    backward-only dgrad psum, "moe" dispatch/combine micro-chunk);
+    `rows` is the chunked dim pow2-bucketed (batch-shape-derived, must
+    not fragment the cache); `width` the GEMM output width;
+    `axis_size` the collective's axis size (overlap economics change
+    with ring length).  dtype None means the bench dtype, bfloat16."""
+    import jax.numpy as jnp
+
+    dtype = jnp.bfloat16 if dtype is None else dtype
+    return dict(path=str(path), rows=pow2_bucket(rows), width=int(width),
+                ax=int(axis_size), dtype=jnp.dtype(dtype).name)
 
 
 def tuned(op: str, attrs=None, **kw):

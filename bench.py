@@ -911,6 +911,113 @@ def _stamp_moe(result, d):
     result["moe_z_loss"] = float(d["z_loss"])
 
 
+def _overlap_measure(on_tpu):
+    """Chunked-vs-monolithic TP step latency (ISSUE 18): the tp=2
+    sequence-parallel GPT step — the SAME model/optimizer build as the
+    comms/timeline probes' `gpt_tp_overlap` flagship — timed in BOTH
+    collective spellings.  `overlap_chunks=1` keeps the ORIGINAL
+    monolithic all-gather / reduce-scatter program (byte-identical HLO
+    to the pre-chunking layers); `overlap_chunks=2` decomposes the
+    column-parallel gather into a ppermute ring interleaved with
+    partial GEMMs and chunks the row-parallel reduce-scatter.  Both
+    legs run under the RecompileSentry; the speedup ratio is the
+    number the chunking exists to move (>1 only where the backend
+    actually runs collectives async — CPU rings add pure per-chunk
+    latency, the honest c*alpha floor docs/PERF.md prices)."""
+    from apex_tpu.models.gpt import GPT, GPTConfig
+    from apex_tpu.optimizers.fused_adam import FusedAdam
+    from apex_tpu.parallel import mesh as M
+    from apex_tpu.transformer.training import (
+        init_sharded_optimizer,
+        make_tp_dp_train_step,
+    )
+
+    chunks = 2
+    out = {"tp": 2, "chunks": chunks}
+    iters, warmup = (20, 3) if on_tpu else (3, 1)
+    for spelling, c in (("monolithic", 1), ("chunked", chunks)):
+        if on_tpu:
+            batch, seq = 12, 1024
+            cfg = GPTConfig(vocab_size=50304, seq_len=seq, hidden=1024,
+                            num_layers=24, num_heads=16, dropout=0.0,
+                            dtype=jnp.bfloat16,
+                            logits_dtype=jnp.bfloat16, remat=False,
+                            use_flash_attention=True,
+                            sequence_parallel=True, overlap_chunks=c)
+        else:
+            batch, seq = 2, 64
+            cfg = GPTConfig(vocab_size=512, seq_len=seq, hidden=64,
+                            num_layers=2, num_heads=4, dropout=0.0,
+                            sequence_parallel=True, overlap_chunks=c)
+        M.destroy_model_parallel()
+        mesh = M.initialize_model_parallel(tensor_model_parallel_size=2)
+        dp = mesh.devices.size // 2
+        batch = -(-batch // max(1, dp)) * max(1, dp)
+        model = GPT(cfg)
+        params = model.init(jax.random.PRNGKey(0))
+        opt = FusedAdam(lr=1e-4, use_pallas=on_tpu,
+                        master_dtype=jnp.bfloat16 if on_tpu
+                        else jnp.float32)
+        state = init_sharded_optimizer(opt, model, params, mesh)
+        step = make_tp_dp_train_step(model, opt, mesh, donate=True)
+        del params
+        tokens = jax.random.randint(jax.random.PRNGKey(1),
+                                    (batch, seq), 0, cfg.vocab_size)
+        labels = jnp.roll(tokens, -1, axis=1)
+        dt = _time_steps(step, state, tokens, labels, iters, warmup,
+                         name=f"gpt_tp_overlap_{spelling}")
+        out[f"{spelling}_step_ms"] = round(dt * 1e3, 3)
+        out[f"{spelling}_tokens_per_sec"] = round(batch * seq / dt, 1)
+        M.destroy_model_parallel()
+    out["speedup"] = round(
+        out["monolithic_step_ms"] / out["chunked_step_ms"], 3)
+    return out
+
+
+def _overlap_chunks_bench(on_tpu):
+    """Run `_overlap_measure`, in-process where the backend already
+    exposes >= 2 devices (TPU), else in a fresh child with two forced
+    host CPU devices — tp=2 needs a 2-device mesh, and XLA_FLAGS must
+    be set before the child's jax import (the comms_probe trick; this
+    parent imported jax long ago)."""
+    if jax.device_count() >= 2:
+        return _overlap_measure(on_tpu)
+    import os
+    import subprocess
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                        + " --xla_force_host_platform_device_count=2"
+                        ).strip()
+    out = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--overlap-child"],
+        capture_output=True, text=True, timeout=900, check=True,
+        env=env)
+    # reverse-scan for the JSON line, the _run_isolated rule (plugin
+    # log lines on stdout after the JSON are a known hazard)
+    for line in reversed(out.stdout.strip().splitlines()):
+        try:
+            d = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(d, dict) and "chunked_step_ms" in d:
+            return d
+    raise ValueError("no JSON line in --overlap-child stdout")
+
+
+def _stamp_overlap(result, d):
+    """Flat `overlap_*` scalars for the chunked-TP leg + the full dict
+    under `tp_overlap`.  Bench-result-only keys: `overlap_` is NOT one
+    of the logger's reserved record prefixes — these never ride a
+    MetricsLogger record, so SCHEMA_VERSION stays at 11."""
+    result["tp_overlap"] = d
+    result["overlap_chunks"] = int(d["chunks"])
+    result["overlap_monolithic_step_ms"] = float(d["monolithic_step_ms"])
+    result["overlap_chunked_step_ms"] = float(d["chunked_step_ms"])
+    result["overlap_step_speedup"] = float(d["speedup"])
+
+
 def _adam_1b_step_ms(on_tpu):
     """Fused flat-buffer Adam step at 1B params (fp32 p/m/v, bf16
     grads) — the large-param optimizer north star (BASELINE.md;
@@ -1129,6 +1236,11 @@ def main():
     from apex_tpu.monitor import SCHEMA_VERSION
 
     on_tpu = jax.default_backend() not in ("cpu",)
+    if "--overlap-child" in sys.argv[1:]:
+        # child of _overlap_chunks_bench: the parent exported XLA_FLAGS
+        # forcing 2 host devices before this process's jax import
+        print(json.dumps(_overlap_measure(on_tpu)))
+        return
     if "--only" in sys.argv[1:]:
         if len(sys.argv) != 3 or sys.argv[1] != "--only":
             print("usage: bench.py [--only METRIC]", file=sys.stderr)
@@ -1243,6 +1355,18 @@ def main():
         _stamp_moe(result, moe_d)
     except Exception as e:
         result["moe_error"] = repr(e)[:120]
+    # chunked-collective overlap (ISSUE 18): the tp=2 SP flagship step
+    # timed in BOTH spellings — monolithic collectives
+    # (overlap_chunks=1, byte-identical to the pre-chunking program)
+    # vs the ppermute-ring chunked pipeline (overlap_chunks=2, the
+    # comms/timeline probes' gpt_tp_overlap target).  (_stamp_overlap:
+    # flat overlap_* scalars + the dict under `tp_overlap`)
+    try:
+        with _timed(durations, "tp_overlap"):
+            ov = _retry(_overlap_chunks_bench, on_tpu)
+        _stamp_overlap(result, ov)
+    except Exception as e:
+        result["overlap_error"] = repr(e)[:120]
     # serving axes (ISSUE 8): decode tokens/s + p50/p99 per-token
     # latency at N concurrent streams, and the sentry's churn verdict
     # (_stamp_serve: flat serve_* scalars + the full sweep dict)

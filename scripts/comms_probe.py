@@ -76,11 +76,18 @@ _FIXTURE_MARKERS = (
     "| all-reduce",
     "| reduce-scatter",
     "| all-to-all",
+    "| collective-permute",
     "| ep ",
+    "| tp ",
     "**SER**",
     "SERIALIZED collective(s)",
     "roofline: predicted comm",
 )
+
+# the seeded serialized-chunk negative control (ISSUE 18): one chunk
+# of the fixture's chunked-TP ring pair is seeded serialized and must
+# stay flagged BY NAME, or the gate is blind to ring-hop regressions
+_SEEDED_SERIALIZED_CHUNK = "collective-permute-start.8"
 
 
 def selftest() -> int:
@@ -107,6 +114,25 @@ def selftest() -> int:
     if not ser:
         print("comms_probe --selftest: the fixture's seeded serialized "
               "collective is no longer flagged — the gate is blind",
+              file=sys.stderr)
+        return 1
+    if _SEEDED_SERIALIZED_CHUNK not in {c["name"] for c in ser}:
+        print("comms_probe --selftest: the seeded serialized ring "
+              f"CHUNK ({_SEEDED_SERIALIZED_CHUNK}) is no longer "
+              "flagged — the gate is blind to chunked-overlap "
+              "regressions", file=sys.stderr)
+        return 1
+    # the chunked-shape pin: the fixture's ring pair must stay
+    # chunk-count-many EQUAL-payload hops (2 x 2 MiB = the displaced
+    # monolithic all-gather shard) — the inventory shape the live
+    # gpt_tp_overlap gate pins against the chunks=1 spelling
+    chunk_pool = [c for c in rep["collectives"]
+                  if c["kind"] == "collective-permute"]
+    payloads = {c["operand_bytes"] for c in chunk_pool}
+    if len(chunk_pool) != 2 or payloads != {2097152}:
+        print("comms_probe --selftest: the fixture's chunked ring "
+              f"pair drifted (n={len(chunk_pool)}, "
+              f"payloads={sorted(payloads)}; want 2 x 2097152 B)",
               file=sys.stderr)
         return 1
     print(text)
@@ -186,6 +212,61 @@ def _build_anatomy(target):
     return step, args
 
 
+def _build_gpt_tp_overlap(on_tpu, chunks=2):
+    """The flagship CHUNKED-TP GPT step (ISSUE 18): tp=2
+    sequence-parallel GPT with `overlap_chunks` forced (bypassing the
+    tuner so the inventory is deterministic on untuned machines) —
+    the column-parallel all-gather+GEMM decomposed into a ppermute
+    ring interleaved with partial GEMMs, the row-parallel
+    reduce-scatter chunked along the sequence.  The gate pins the
+    chunked program's collective inventory against the monolithic
+    (chunks=1) spelling of the SAME model: chunk-count-many smaller
+    collectives, displaced all-gather bytes reappearing as equal ring
+    ppermute traffic.  dp takes the remaining devices; on TPU the
+    350M bench config, on CPU the smoke config."""
+    import jax
+    import jax.numpy as jnp
+
+    from apex_tpu.models.gpt import GPT, GPTConfig
+    from apex_tpu.optimizers.fused_adam import FusedAdam
+    from apex_tpu.parallel import mesh as M
+    from apex_tpu.transformer.training import (
+        init_sharded_optimizer,
+        make_tp_dp_train_step,
+    )
+
+    if on_tpu:
+        batch, seq = 12, 1024
+        cfg = GPTConfig(vocab_size=50304, seq_len=seq, hidden=1024,
+                        num_layers=24, num_heads=16, dropout=0.0,
+                        dtype=jnp.bfloat16, logits_dtype=jnp.bfloat16,
+                        remat=False, use_flash_attention=True,
+                        sequence_parallel=True,
+                        overlap_chunks=chunks)
+    else:
+        batch, seq = 2, 64
+        cfg = GPTConfig(vocab_size=512, seq_len=seq, hidden=64,
+                        num_layers=2, num_heads=4, dropout=0.0,
+                        sequence_parallel=True,
+                        overlap_chunks=chunks)
+    _build_gpt_tp_overlap.layers = cfg.num_layers
+    M.destroy_model_parallel()
+    mesh = M.initialize_model_parallel(tensor_model_parallel_size=2)
+    dp = mesh.devices.size // 2
+    batch = -(-batch // max(1, dp)) * max(1, dp)
+    model = GPT(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+    opt = FusedAdam(lr=1e-4, use_pallas=on_tpu,
+                    master_dtype=jnp.bfloat16 if on_tpu
+                    else jnp.float32)
+    opt_state = init_sharded_optimizer(opt, model, params, mesh)
+    step = make_tp_dp_train_step(model, opt, mesh, donate=True)
+    del params
+    tokens = jax.ShapeDtypeStruct((batch, seq), jnp.int32)
+    labels = jax.ShapeDtypeStruct((batch, seq), jnp.int32)
+    return step, (opt_state, tokens, labels)
+
+
 def _build_serve():
     """The flagship serving DECODE step (apex_tpu.serve, ISSUE 8).
     Single-chip serving emits ZERO collectives — this target is the
@@ -228,8 +309,87 @@ BUILDERS = {
     "bert": lambda: _build_anatomy("bert"),
     "serve": _build_serve,
     "moe": _build_moe,
+    "gpt_tp_overlap": lambda: _build_gpt_tp_overlap(
+        __import__("jax").default_backend() not in ("cpu",)),
 }
-DEFAULT_TARGETS = ("gpt_zero2", "gpt", "serve", "moe")
+DEFAULT_TARGETS = ("gpt_zero2", "gpt", "serve", "moe",
+                   "gpt_tp_overlap")
+
+# the chunked-TP flagship's shape knobs, shared with the inventory pin
+# (kept in one place so the expected-count formula and the builder
+# can't drift apart)
+_TP_OVERLAP_TP = 2
+_TP_OVERLAP_CHUNKS = 2
+
+
+def _pin_tp_overlap_inventory(chunked, mono, layers, as_json) -> int:
+    """Pin the chunked-TP program's collective inventory against the
+    monolithic (chunks=1) spelling of the SAME model — the ISSUE 18
+    contract: chunk-count-many smaller collectives, same total bytes
+    (± padding).  Measured invariants (tp=p, c=chunks, L layers):
+
+      * the monolithic program emits ZERO collective-permutes; the
+        chunked one emits exactly 2·(2L)·(p−1)·c ring hops — (fwd
+        ring + wgrad ring) × (qkv, fc1 per layer) × (p−1) hops ×
+        c chunks — all carrying the SAME per-hop payload (every ring
+        moves x-chunks, so hop sizes are uniform),
+      * reduce-scatter bytes are conserved (c× more, each c× smaller),
+      * the displaced all-gather bytes reappear as ring traffic:
+        cp_bytes == 2 × (ag_bytes_mono − ag_bytes_chunked) — the
+        factor 2 is the wgrad ring re-moving what the fwd ring moved
+        (the monolithic spelling saves gathered x as a residual
+        instead; chunking trades those bytes for overlap + memory),
+      * the dp grad-sync plane (all-reduce) is byte-identical —
+        chunking must not leak into the data-parallel collectives.
+    """
+    p, c = _TP_OVERLAP_TP, _TP_OVERLAP_CHUNKS
+    fails = []
+    cp = [x for x in chunked["collectives"]
+          if x["kind"] == "collective-permute"]
+    if mono["counts"].get("collective-permute", 0):
+        fails.append("monolithic (chunks=1) spelling emits "
+                     "collective-permute — the chunks=1 path is no "
+                     "longer the pre-overlap program")
+    want = 2 * (2 * layers) * (p - 1) * c
+    if len(cp) != want:
+        fails.append(f"ring ppermute count {len(cp)} != expected "
+                     f"{want} (= 2 rings x {2 * layers} col sites x "
+                     f"{p - 1} hops x {c} chunks)")
+    sizes = sorted({x["operand_bytes"] for x in cp})
+    if len(sizes) > 1:
+        fails.append(f"ring hop payloads not uniform: {sizes}")
+    ag_m = mono["bytes_by_kind"].get("all-gather", 0)
+    ag_c = chunked["bytes_by_kind"].get("all-gather", 0)
+    cp_b = chunked["bytes_by_kind"].get("collective-permute", 0)
+    displaced = ag_m - ag_c
+    if displaced <= 0 or cp_b <= 0 or \
+            abs(cp_b - 2 * displaced) > 0.05 * max(cp_b, 1):
+        fails.append(f"displaced all-gather bytes ({displaced}) != "
+                     f"ring bytes/2 ({cp_b}/2) beyond padding")
+    rs_m = mono["bytes_by_kind"].get("reduce-scatter", 0)
+    rs_c = chunked["bytes_by_kind"].get("reduce-scatter", 0)
+    if abs(rs_c - rs_m) > 0.05 * max(rs_m, 1):
+        fails.append(f"reduce-scatter bytes not conserved: "
+                     f"{rs_m} -> {rs_c}")
+    if chunked["bytes_by_kind"].get("all-reduce", 0) != \
+            mono["bytes_by_kind"].get("all-reduce", 0):
+        fails.append("chunking leaked into the dp all-reduce plane")
+    if as_json:
+        print(json.dumps({"target": "gpt_tp_overlap_inventory_pin",
+                          "n_ring_hops": len(cp),
+                          "expected_ring_hops": want,
+                          "ring_bytes": cp_b,
+                          "displaced_all_gather_bytes": displaced,
+                          "fails": fails, "ok": not fails}))
+    else:
+        print(f"inventory pin (chunks={c} vs monolithic): "
+              f"{len(cp)} ring hop(s) of {sizes[0] if sizes else 0} B "
+              f"replace {displaced} displaced all-gather byte(s)")
+        for f in fails:
+            print(f"inventory pin: FAIL — {f}")
+        print(f"inventory pin: {'FAIL' if fails else 'PASS'}")
+        print()
+    return 1 if fails else 0
 
 
 def _gate_report(rep_dict, target, allowlist, as_json) -> int:
@@ -307,6 +467,18 @@ def main() -> int:
         step, step_args = BUILDERS[t]()
         rep = comms.comms_report(step, step_args)
         rc |= _gate_report(rep.to_dict(), t, allowlist, args.json)
+        if t == "gpt_tp_overlap":
+            # the chunked target carries a second gate: its inventory
+            # pinned against the monolithic spelling of the same model
+            import jax
+
+            on_tpu = jax.default_backend() not in ("cpu",)
+            mono_step, mono_args = _build_gpt_tp_overlap(
+                on_tpu, chunks=1)
+            mono = comms.comms_report(mono_step, mono_args)
+            rc |= _pin_tp_overlap_inventory(
+                rep.to_dict(), mono.to_dict(),
+                _build_gpt_tp_overlap.layers, args.json)
         M.destroy_model_parallel()
     if not args.json:
         verdict = "CLEAN" if rc == 0 else "SERIALIZED — gate fails"

@@ -42,6 +42,12 @@ Usage:
   python scripts/gpt_anatomy.py timeline [targets...]      # MEASURED step anatomy from
                                                            # a profiler capture (executes
                                                            # 3 steady steps)
+  python scripts/gpt_anatomy.py overlap [targets...]       # predicted-vs-measured
+                                                           # per-collective overlap,
+                                                           # chunked (overlap_chunks=2)
+                                                           # vs monolithic spelling of
+                                                           # the same tp=2 SP layer
+                                                           # stack (executes both)
 
 `tune` drives apex_tpu.tune.search over each target's flash shape (and
 the flat-Adam block at the 1B point), writes the winners to the
@@ -677,6 +683,171 @@ def timeline_mode(targets, n_steps=3):
     return rc
 
 
+def _build_overlap_step(t, on_tpu, chunks):
+    """The CONFIGS target rebuilt as a tp=2 SEQUENCE-PARALLEL GPT with
+    `overlap_chunks` forced — the chunked (AFTER) vs monolithic
+    (BEFORE) spelling of the SAME layer stack for overlap_mode.
+    Forcing the chunk count bypasses the tuner so both spellings are
+    deterministic on untuned machines; everything else (model dims,
+    optimizer, loss, mesh) is held fixed, so any inventory or overlap
+    difference between the two is the chunking and nothing else."""
+    import jax.numpy as jnp
+
+    from apex_tpu.models.gpt import GPT, GPTConfig
+    from apex_tpu.optimizers.fused_adam import FusedAdam
+    from apex_tpu.parallel import mesh as M
+    from apex_tpu.transformer.training import (
+        init_sharded_optimizer,
+        make_tp_dp_train_step,
+    )
+
+    nm, h, L, H, b, s, v, causal = CONFIGS[t]
+    if not causal:
+        sys.exit(f"overlap mode needs a causal GPT target, not {nm}")
+    if on_tpu:
+        batch = b
+        cfg = GPTConfig(vocab_size=v, seq_len=s, hidden=h,
+                        num_layers=L, num_heads=H, dropout=0.0,
+                        dtype=jnp.bfloat16, logits_dtype=jnp.bfloat16,
+                        remat=False, use_flash_attention=True,
+                        sequence_parallel=True, overlap_chunks=chunks)
+    else:
+        print(f"--- overlap {nm}: CPU backend, shrinking to the smoke "
+              "config (structure only; run on TPU for measured "
+              "overlap)", flush=True)
+        h, L, H, v = 64, 2, 4, 512
+        batch, s = 2, 64
+        cfg = GPTConfig(vocab_size=v, seq_len=s, hidden=h,
+                        num_layers=L, num_heads=H, dropout=0.0,
+                        sequence_parallel=True, overlap_chunks=chunks)
+    M.destroy_model_parallel()
+    mesh = M.initialize_model_parallel(tensor_model_parallel_size=2)
+    dp = mesh.devices.size // 2
+    batch = -(-batch // max(1, dp)) * max(1, dp)
+    model = GPT(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+    opt = FusedAdam(lr=1e-4, use_pallas=on_tpu,
+                    master_dtype=jnp.bfloat16 if on_tpu
+                    else jnp.float32)
+    opt_state = init_sharded_optimizer(opt, model, params, mesh)
+    step = make_tp_dp_train_step(model, opt, mesh, donate=True)
+    del params
+    tokens = jax.ShapeDtypeStruct((batch, s), jnp.int32)
+    labels = jax.ShapeDtypeStruct((batch, s), jnp.int32)
+    label = f"{nm}: h{h} L{L} H{H} b{batch} s{s} tp2-sp"
+    return label, step, (opt_state, tokens, labels)
+
+
+def _overlap_kind_summary(crep_dict, xc):
+    """Per-kind rollup of one spelling: count, MiB, mean predicted and
+    mean measured overlap over the counted collectives."""
+    rows = {}
+    meas_by_name = {r["name"]: r["measured_overlap_fraction"]
+                    for r in xc["rows"]}
+    for c in crep_dict["collectives"]:
+        if c.get("group_size", 1) <= 1:
+            continue
+        r = rows.setdefault(c["kind"], dict(n=0, bytes=0, pred=[],
+                                            meas=[]))
+        r["n"] += 1
+        r["bytes"] += c["operand_bytes"]
+        if c.get("overlap_fraction") is not None:
+            r["pred"].append(c["overlap_fraction"])
+        m = meas_by_name.get(c["name"])
+        if m is not None:
+            r["meas"].append(m)
+    return rows
+
+
+def overlap_mode(targets, n_steps=3):
+    """BEFORE/AFTER overlap anatomy (ISSUE 18): for each target, build
+    the tp=2 sequence-parallel step in its MONOLITHIC (chunks=1) and
+    CHUNKED (overlap_chunks=2) spelling, AOT-audit both with the comms
+    observatory (predicted overlap), EXECUTE both under a profiler
+    capture (measured overlap — TPU only; a CPU capture reports the
+    measured plane UNMEASURABLE, honestly), and print the
+    predicted-vs-measured crosscheck table per spelling plus a
+    per-kind BEFORE/AFTER rollup.  This is the artifact docs/PERF.md's
+    "Measured overlap — next TPU session" note asks for: the same
+    layer, two spellings, one table.  Nonzero exit when a trace
+    parsed broken or (on a measurable backend) a crosscheck row
+    DIVERGES."""
+    import tempfile
+
+    import jax.numpy as jnp
+
+    from apex_tpu import monitor
+    from apex_tpu.monitor import comms as comms_lib
+    from apex_tpu.monitor import timeline
+    from apex_tpu.parallel import mesh as M
+
+    on_tpu = jax.default_backend() not in ("cpu",)
+    rc = 0
+    for t in targets:
+        summaries = {}
+        for spelling, chunks in (("monolithic", 1), ("chunked", 2)):
+            label, step, (opt_state, tokens, labels) = \
+                _build_overlap_step(t, on_tpu, chunks)
+            crep = comms_lib.comms_report(
+                step, (opt_state, tokens, labels))
+            tok = jnp.zeros(tokens.shape, tokens.dtype)
+            lab = jnp.zeros(labels.shape, labels.dtype)
+            state = opt_state
+            for _ in range(2):  # compile + donated-layout recompile
+                state, loss = step(state, tok, lab)
+            jax.block_until_ready(state)
+            cap = monitor.profile_capture(
+                range(n_steps),
+                logdir=tempfile.mkdtemp(prefix="anatomy_overlap_"))
+            try:
+                for i in range(n_steps):
+                    with cap.step(i):
+                        state, loss = step(state, tok, lab)
+                        jax.block_until_ready(loss)
+            finally:
+                cap.close()
+            rep = monitor.analyze_trace(cap.trace_path())
+            xc = timeline.crosscheck_comms(rep, crep)
+            print(f"\n--- overlap {label} [{spelling}, "
+                  f"chunks={chunks}] ({n_steps} measured steps)",
+                  flush=True)
+            print(timeline.render_crosscheck(
+                xc, label=f"{label} {spelling}"), flush=True)
+            if not rep.overlap_measurable:
+                print("measured plane: UNMEASURABLE on this backend "
+                      "(honest) — predicted inventory still pins the "
+                      "chunked pattern", flush=True)
+            summaries[spelling] = _overlap_kind_summary(
+                crep.to_dict(), xc)
+            if rep.n_device_events == 0 or len(rep.steps) != n_steps:
+                rc = 1
+            if rep.overlap_measurable and not xc["ok"]:
+                rc = 1
+            M.destroy_model_parallel()
+
+        def _fmt(vals):
+            return (f"{100 * sum(vals) / len(vals):5.1f}%" if vals
+                    else "  n/a ")
+
+        print(f"\n=== overlap BEFORE/AFTER: {t} ===")
+        print("| kind               | spelling   |  n |      MiB | "
+              "pred ovl | meas ovl |")
+        print("|---|---|---|---|---|---|")
+        kinds = sorted(set(summaries["monolithic"])
+                       | set(summaries["chunked"]))
+        for k in kinds:
+            for spelling in ("monolithic", "chunked"):
+                r = summaries[spelling].get(k)
+                if r is None:
+                    print(f"| {k:<18} | {spelling:<10} |  0 |"
+                          f"        - |      -   |      -   |")
+                    continue
+                print(f"| {k:<18} | {spelling:<10} | {r['n']:2d} | "
+                      f"{r['bytes'] / 2**20:8.2f} | {_fmt(r['pred'])} "
+                      f"| {_fmt(r['meas'])} |")
+    return rc
+
+
 CONFIGS = {
     # name: (hidden, layers, heads, batch, seq, vocab, causal)
     "350m": ("GPT-350M", 1024, 24, 16, 12, 1024, 50304, True),
@@ -736,6 +907,13 @@ if __name__ == "__main__":
             sys.exit(f"unknown timeline target(s) {bad}; "
                      f"choices: {sorted(CONFIGS)}")
         sys.exit(timeline_mode(targets))
+    elif which == "overlap":
+        targets = sys.argv[2:] or ["350m"]
+        bad = [t for t in targets if t not in CONFIGS]
+        if bad:
+            sys.exit(f"unknown overlap target(s) {bad}; "
+                     f"choices: {sorted(CONFIGS)}")
+        sys.exit(overlap_mode(targets))
     elif which == "blocks":
         flash_block_sweep(causal=False)   # BERT shape
         flash_block_sweep(batch=7, heads=32, seq=512, causal=True)  # 1.3B

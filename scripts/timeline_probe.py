@@ -80,9 +80,17 @@ _FIXTURE_MARKERS = (
     "| step |",
     "aggregate: device busy",
     "collective",
+    "collective-permute",
     "**SER**",
     "MEASURED-SERIALIZED",
 )
+
+# the seeded serialized-chunk negative control (ISSUE 18): one chunk
+# of the fixture's chunked-TP ring pair is seeded MEASURED-SERIALIZED
+# and must stay flagged BY NAME — a renderer or analyzer change that
+# stops surfacing a serialized ring hop would blind the measured gate
+# to exactly the regression chunked overlap exists to prevent
+_SEEDED_SERIALIZED_CHUNK = "collective-permute.8"
 
 
 # ------------------------- seeded control traces -------------------------
@@ -136,6 +144,16 @@ def selftest() -> int:
         print("timeline_probe --selftest: the fixture's seeded "
               "measured-serialized collective is no longer flagged — "
               "the gate is blind", file=sys.stderr)
+        return 1
+    if _SEEDED_SERIALIZED_CHUNK not in {c["name"] for c in ser}:
+        print("timeline_probe --selftest: the seeded serialized ring "
+              f"CHUNK ({_SEEDED_SERIALIZED_CHUNK}) is no longer "
+              "flagged — the measured gate is blind to chunked-"
+              "overlap regressions", file=sys.stderr)
+        return 1
+    if _SEEDED_SERIALIZED_CHUNK not in text:
+        print("timeline_probe --selftest: the serialized ring chunk "
+              "vanished from the rendering", file=sys.stderr)
         return 1
     print(text)
 
@@ -199,6 +217,23 @@ def _build(target, on_tpu):
             return out[2]
 
         return step, (state, scaler, batch), run
+    if target == "gpt_tp_overlap":
+        # the chunked-TP flagship (ISSUE 18): the ppermute-ring /
+        # chunked-reduce program whose measured per-hop overlap the
+        # crosscheck below judges against the AOT prediction
+        import comms_probe
+
+        step, (opt_state, tokens, labels) = \
+            comms_probe._build_gpt_tp_overlap(on_tpu)
+        live = [_materialize(opt_state), _materialize(tokens),
+                _materialize(labels)]
+
+        def run():
+            out = step(live[0], live[1], live[2])
+            live[0] = out[0]
+            return out[1]
+
+        return step, (opt_state, tokens, labels), run
     import gpt_anatomy
 
     import jax
@@ -216,8 +251,8 @@ def _build(target, on_tpu):
     return step, (opt_state, tokens, labels), run
 
 
-TARGETS = ("gpt", "gpt_zero2", "bert")
-DEFAULT_TARGETS = ("gpt", "gpt_zero2")
+TARGETS = ("gpt", "gpt_zero2", "bert", "gpt_tp_overlap")
+DEFAULT_TARGETS = ("gpt", "gpt_zero2", "gpt_tp_overlap")
 
 
 def _probe_target(target, n_steps, logdir, as_json) -> int:
@@ -286,9 +321,14 @@ def _probe_target(target, n_steps, logdir, as_json) -> int:
         rc = 1
 
     xc = None
-    if target == "gpt_zero2":
+    if target in ("gpt_zero2", "gpt_tp_overlap"):
         # the predicted-vs-measured loop: one row per counted
-        # collective of the AOT report, expected-overlap ones included
+        # collective of the AOT report, expected-overlap ones
+        # included.  On the chunked-TP target this is where the
+        # chunk-count-many ring hops meet their measured spans — the
+        # name-prefix grouping in crosscheck_comms keeps a chunk's
+        # span with its own logical collective when the trace
+        # renumbers instances
         crep = comms_lib.comms_report(step, abstract_args)
         xc = timeline.crosscheck_comms(rep, crep)
         n_counted = sum(crep.to_dict()["counts"].values())

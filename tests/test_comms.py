@@ -513,8 +513,11 @@ def test_comms_probe_cli_flagships_clean():
     """Acceptance: `scripts/comms_probe.py` exits 0 on the flagship
     steps (ZeRO-2 dp step + GPT smoke) with the EMPTY committed
     allowlist, and its inventory finds the per-bucket
-    reduce-scatters."""
-    r = _run_script(ROOT / "scripts" / "comms_probe.py", "--json")
+    reduce-scatters.  (The chunked-TP flagship has its own slow-marked
+    test below — it builds the model TWICE for the inventory pin,
+    which would stretch this tier-1 gate.)"""
+    r = _run_script(ROOT / "scripts" / "comms_probe.py", "--json",
+                    "gpt_zero2", "gpt", "serve", "moe")
     assert r.returncode == 0, r.stdout + r.stderr
     reports = [json.loads(line) for line in r.stdout.splitlines()
                if line.startswith("{")]
@@ -527,6 +530,32 @@ def test_comms_probe_cli_flagships_clean():
     serve = next(x for x in reports if x["target"] == "serve")
     assert serve["report"]["collectives"] == []
     assert serve["new"] == []
+
+
+@pytest.mark.slow
+def test_comms_probe_tp_overlap_target():
+    """ISSUE 18 acceptance: the chunked-TP flagship passes the comms
+    gate with the EMPTY committed allowlist, and the inventory pin
+    holds — chunk-count-many equal-payload ring ppermutes whose bytes
+    equal twice the displaced all-gather traffic, reduce-scatter and
+    dp all-reduce planes conserved, monolithic (chunks=1) spelling
+    ppermute-free."""
+    r = _run_script(ROOT / "scripts" / "comms_probe.py", "--json",
+                    "gpt_tp_overlap")
+    assert r.returncode == 0, r.stdout + r.stderr
+    reports = [json.loads(line) for line in r.stdout.splitlines()
+               if line.startswith("{")]
+    main = next(x for x in reports if x["target"] == "gpt_tp_overlap")
+    cp = [c for c in main["report"]["collectives"]
+          if c["kind"] == "collective-permute"]
+    # 2 rings (fwd + wgrad) x 2L col sites x (p-1) hops x chunks
+    assert len(cp) == 16 and all(c["axes"] == ["tp"] for c in cp)
+    assert len({c["operand_bytes"] for c in cp}) == 1
+    pin = next(x for x in reports
+               if x["target"] == "gpt_tp_overlap_inventory_pin")
+    assert pin["ok"], pin["fails"]
+    assert pin["n_ring_hops"] == pin["expected_ring_hops"] == 16
+    assert pin["ring_bytes"] == 2 * pin["displaced_all_gather_bytes"]
 
 
 def test_comms_probe_gates_serialized_report():
@@ -542,7 +571,10 @@ def test_comms_probe_gates_serialized_report():
     import tempfile
     with tempfile.NamedTemporaryFile("w", suffix=".txt",
                                      delete=False) as f:
-        f.write("reduce-scatter *reduce-scatter-start*\n")
+        # both seeded serialized entries: the ZeRO-2 reduce-scatter
+        # and the ISSUE 18 serialized ring chunk
+        f.write("reduce-scatter *reduce-scatter-start*\n"
+                "collective-permute *collective-permute-start*\n")
         allowpath = f.name
     try:
         r2 = _run_script(ROOT / "scripts" / "comms_probe.py",

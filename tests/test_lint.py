@@ -245,6 +245,52 @@ def test_cl206_all_to_all_undeclared_axis():
     assert "CL206" in rules_of(fs)
 
 
+def test_cl207_incomplete_ppermute_ring():
+    """A one-directional chain perm (the broken ring, ISSUE 18): rank 0
+    sends but receives from nobody, so lax.ppermute silently hands it
+    ZEROS — the hazard the chunked ring-overlap pipelines multiply by
+    chunk count."""
+    def chain(x):
+        perm = [(i, i + 1) for i in range(3)]   # 4 ranks, no wrap
+        return jax.lax.ppermute(x, "tp", perm)
+
+    fs = lint.lint_program(chain, (SDS((8,), jnp.float32),),
+                           axis_env=[("tp", 4)])
+    hits = [f for f in fs if f.rule == "CL207"]
+    assert len(hits) == 1
+    assert "ZEROS" in hits[0].message and "[0]" in hits[0].message
+
+
+def test_cl207_duplicate_destination():
+    def dup(x):
+        return jax.lax.ppermute(x, "tp", [(0, 1), (2, 1), (1, 0)])
+
+    fs = lint.lint_program(dup, (SDS((8,), jnp.float32),),
+                           axis_env=[("tp", 4)])
+    assert "CL207" in rules_of(fs)
+    hit = next(f for f in fs if f.rule == "CL207")
+    assert "destinations" in hit.message
+
+
+def test_cl207_complete_rings_clean():
+    """ring_exchange / halo_exchange_1d spell complete cyclic perms —
+    every sender receives — so the real overlap building blocks stay
+    finding-free."""
+    from apex_tpu.parallel import collectives as C
+
+    def ring(x):
+        return C.ring_exchange(x, "tp", shift=-1)
+
+    def halo(x):
+        left, right = C.halo_exchange_1d(x, "tp", halo=1, dim=0)
+        return left + right
+
+    for f in (ring, halo):
+        fs = lint.lint_program(f, (SDS((8, 4), jnp.float32),),
+                               axis_env=[("tp", 4)])
+        assert "CL207" not in rules_of(fs), f.__name__
+
+
 def test_dp105_low_precision_router_selection():
     """A bf16 router softmax feeding top_k is a finding; the
     apex_tpu.moe contract — bf16 gate GEMM operands with fp32

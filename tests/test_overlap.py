@@ -1,0 +1,391 @@
+"""Chunked compute/collective overlap numerics (ISSUE 18).
+
+The contract parallel/overlap.py must keep: every chunked spelling is
+allclose (fp32-accum, tolerance-pinned per dtype) to the monolithic
+spelling for chunk counts {1, 2, 4} x (fwd, bwd) x (bf16, fp32), the
+chunks == 1 path is BYTE-IDENTICAL to the pre-overlap program (it IS
+the original code path — pinned here by lowered-HLO equality), and a
+non-dividing chunk request falls back to the largest dividing count
+with a single warning (the flash-attention block rule).
+"""
+
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import lax, shard_map
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu.moe import dispatch as D
+from apex_tpu.moe import router as R
+from apex_tpu.moe.layer import MoEMLP
+from apex_tpu.parallel import mesh as M
+from apex_tpu.parallel import overlap as OV
+from apex_tpu.transformer.tensor_parallel.layers import (
+    ColumnParallelLinear,
+    RowParallelLinear,
+)
+
+# tolerance per dtype: the chunked GEMMs contract the same rows with
+# fp32 MXU accumulation, but XLA retiles the partials, so allow
+# accumulation-order wobble (tight for fp32, one-ulp-ish for bf16)
+_TOL = {jnp.float32: dict(rtol=3e-5, atol=3e-5),
+        jnp.bfloat16: dict(rtol=3e-2, atol=3e-2)}
+
+DTYPES = [jnp.float32, jnp.bfloat16]
+CHUNKS = [1, 2, 4]
+
+
+def _allclose(a, b, dtype):
+    np.testing.assert_allclose(
+        np.asarray(a, np.float32), np.asarray(b, np.float32),
+        **_TOL[dtype])
+
+
+def _tp_mesh(tp=4):
+    M.destroy_model_parallel()
+    return M.initialize_model_parallel(tensor_model_parallel_size=tp)
+
+
+# ----------------------------- TP layers ------------------------------
+#
+# One runner per layer shape: build the layer at a given chunk count,
+# run fwd + value_and_grad of a fixed linear probe loss INSIDE
+# shard_map (the training-step convention — the custom_vjp collectives
+# make per-shard grads of the global loss correct), compare every
+# chunked result against the chunks=1 monolithic anchor.
+
+def _run_layer(layer, specs, w, b, x, t, mesh):
+    w_spec, b_spec, x_spec, y_spec = specs
+
+    def local(w_l, b_l, x_l, t_l):
+        def loss_fn(args):
+            w_, b_, x_ = args
+            y = layer.apply({"weight": w_, "bias": b_}, x_)
+            return jnp.sum(y.astype(jnp.float32)
+                           * t_l.astype(jnp.float32))
+        loss, grads = jax.value_and_grad(loss_fn)((w_l, b_l, x_l))
+        y = layer.apply({"weight": w_l, "bias": b_l}, x_l)
+        return y, loss.reshape(1), grads
+
+    f = shard_map(local, mesh=mesh,
+                  in_specs=(w_spec, b_spec, x_spec, y_spec),
+                  out_specs=((y_spec, P(),
+                              (w_spec, b_spec, x_spec))),
+                  check_vma=False)
+    y, loss, (dw, db, dx) = jax.jit(f)(w, b, x, t)
+    return y, loss, dw, db, dx
+
+
+def _col_sp_case(chunks, dtype, tp=4, s_loc=8, bsz=2, h=16, o=32):
+    mesh = _tp_mesh(tp)
+    k = jax.random.PRNGKey(0)
+    kw, kb, kx, kt = jax.random.split(k, 4)
+    w = jax.random.normal(kw, (h, o), jnp.float32).astype(dtype)
+    b = jax.random.normal(kb, (o,), jnp.float32).astype(dtype)
+    x = jax.random.normal(kx, (tp * s_loc, bsz, h),
+                          jnp.float32).astype(dtype)
+    t = jax.random.normal(kt, (tp * s_loc, bsz, o), jnp.float32)
+    lay = ColumnParallelLinear(h, o, sequence_parallel=True,
+                               axis_name="tp", overlap_chunks=chunks)
+    specs = (P(None, "tp"), P("tp"), P("tp"), P(None, None, "tp"))
+    return _run_layer(lay, specs, w, b, x, t, mesh)
+
+
+def _row_sp_case(chunks, dtype, tp=4, s=32, bsz=2, h=16, o=24):
+    mesh = _tp_mesh(tp)
+    k = jax.random.PRNGKey(1)
+    kw, kb, kx, kt = jax.random.split(k, 4)
+    w = jax.random.normal(kw, (h, o), jnp.float32).astype(dtype)
+    b = jax.random.normal(kb, (o,), jnp.float32).astype(dtype)
+    x = jax.random.normal(kx, (s, bsz, h), jnp.float32).astype(dtype)
+    t = jax.random.normal(kt, (s, bsz, o), jnp.float32)
+    lay = RowParallelLinear(h, o, sequence_parallel=True,
+                            axis_name="tp", overlap_chunks=chunks)
+    specs = (P("tp", None), P(), P(None, None, "tp"), P("tp"))
+    return _run_layer(lay, specs, w, b, x, t, mesh)
+
+
+def _row_ar_case(chunks, dtype, tp=4, s=16, bsz=2, h=16, o=24):
+    mesh = _tp_mesh(tp)
+    k = jax.random.PRNGKey(2)
+    kw, kb, kx, kt = jax.random.split(k, 4)
+    w = jax.random.normal(kw, (h, o), jnp.float32).astype(dtype)
+    b = jax.random.normal(kb, (o,), jnp.float32).astype(dtype)
+    x = jax.random.normal(kx, (s, bsz, h), jnp.float32).astype(dtype)
+    t = jax.random.normal(kt, (s, bsz, o), jnp.float32)
+    lay = RowParallelLinear(h, o, sequence_parallel=False,
+                            axis_name="tp", overlap_chunks=chunks)
+    specs = (P("tp", None), P(), P(None, None, "tp"), P())
+    return _run_layer(lay, specs, w, b, x, t, mesh)
+
+
+def _col_copy_case(chunks, dtype, tp=4, s=16, bsz=2, h=16, o=32):
+    mesh = _tp_mesh(tp)
+    k = jax.random.PRNGKey(3)
+    kw, kb, kx, kt = jax.random.split(k, 4)
+    w = jax.random.normal(kw, (h, o), jnp.float32).astype(dtype)
+    b = jax.random.normal(kb, (o,), jnp.float32).astype(dtype)
+    x = jax.random.normal(kx, (s, bsz, h), jnp.float32).astype(dtype)
+    t = jax.random.normal(kt, (s, bsz, o), jnp.float32)
+    lay = ColumnParallelLinear(h, o, sequence_parallel=False,
+                               axis_name="tp", overlap_chunks=chunks)
+    specs = (P(None, "tp"), P("tp"), P(), P(None, None, "tp"))
+    return _run_layer(lay, specs, w, b, x, t, mesh)
+
+
+_CASES = {"col_sp": _col_sp_case, "row_sp": _row_sp_case,
+          "row_ar": _row_ar_case, "col_copy": _col_copy_case}
+
+
+@pytest.mark.parametrize("dtype", DTYPES, ids=["fp32", "bf16"])
+@pytest.mark.parametrize("case", sorted(_CASES))
+def test_tp_chunked_allclose_monolithic(case, dtype):
+    """fwd + bwd at chunks in {2, 4} allclose to the chunks=1 anchor
+    for every TP layer shape; grads cover weight, bias AND input (the
+    backward-direction collectives)."""
+    run = _CASES[case]
+    y1, l1, dw1, db1, dx1 = run(1, dtype)
+    for c in (2, 4):
+        yc, lc, dwc, dbc, dxc = run(c, dtype)
+        _allclose(yc, y1, dtype)
+        _allclose(lc, l1, dtype)
+        _allclose(dwc, dw1, dtype)
+        _allclose(dbc, db1, dtype)
+        _allclose(dxc, dx1, dtype)
+
+
+def test_chunks1_bitwise_and_byte_identical():
+    """overlap_chunks=1, =None (tuner miss), and the knob simply not
+    exercised are the SAME program: bitwise outputs and identical
+    lowered HLO — the RecompileSentry/byte-identity anchor for
+    untuned machines."""
+    mesh = _tp_mesh(4)
+    h, o, s_loc, bsz = 16, 32, 8, 2
+    k = jax.random.PRNGKey(0)
+    kw, kx = jax.random.split(k)
+    w = jax.random.normal(kw, (h, o), jnp.float32)
+    x = jax.random.normal(kx, (4 * s_loc, bsz, h), jnp.float32)
+
+    def lowered(chunks):
+        lay = ColumnParallelLinear(h, o, bias=False,
+                                   sequence_parallel=True,
+                                   axis_name="tp",
+                                   overlap_chunks=chunks)
+        f = jax.jit(shard_map(
+            lambda w_, x_: lay.apply({"weight": w_}, x_), mesh=mesh,
+            in_specs=(P(None, "tp"), P("tp")),
+            out_specs=P(None, None, "tp"), check_vma=False))
+        return f, f.lower(w, x).as_text()
+
+    f1, hlo1 = lowered(1)
+    fn, hlon = lowered(None)
+    assert hlo1 == hlon
+    assert np.array_equal(np.asarray(f1(w, x)), np.asarray(fn(w, x)))
+    # and the monolithic program really is collective-permute-free
+    # while chunks=2 trades its all-gather for ring ppermutes
+    _, hlo2 = lowered(2)
+    assert "all_gather" in hlo1 and "collective_permute" not in hlo1
+    assert "collective_permute" in hlo2
+
+
+def test_ring_bytes_drop_all_gather():
+    """The ring spelling's HLO carries (p-1)*chunks collective-permutes
+    and NO all-gather — the (p-1)/p-bytes claim is a program property,
+    pinned here at the unit level (comms_probe pins the flagship)."""
+    mesh = _tp_mesh(4)
+    h, o, s_loc = 16, 32, 8
+    w = jnp.ones((h, o), jnp.float32)
+    x = jnp.ones((4 * s_loc, 2, h), jnp.float32)
+    lay = ColumnParallelLinear(h, o, bias=False, sequence_parallel=True,
+                               axis_name="tp", overlap_chunks=2)
+    hlo = jax.jit(shard_map(
+        lambda w_, x_: lay.apply({"weight": w_}, x_), mesh=mesh,
+        in_specs=(P(None, "tp"), P("tp")),
+        out_specs=P(None, None, "tp"),
+        check_vma=False)).lower(w, x).as_text()
+    assert "all_gather" not in hlo
+    assert hlo.count("stablehlo.collective_permute") == (4 - 1) * 2
+
+
+def test_non_dividing_chunks_fall_back_largest_divisor():
+    """overlap_chunks=3 against 8 local rows: the layer runs at 2
+    chunks (largest divisor), warns ONCE, and stays allclose."""
+    OV._WARNED_SITES.clear()
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter("always")
+        y3, l3, dw3, db3, dx3 = _col_sp_case(3, jnp.float32)
+        _col_sp_case(3, jnp.float32)  # re-trace: no second warning
+    msgs = [str(r.message) for r in rec
+            if "overlap_chunks" in str(r.message)]
+    assert len(msgs) == 1 and "falling back to 2" in msgs[0]
+    y1, l1, dw1, db1, dx1 = _col_sp_case(1, jnp.float32)
+    _allclose(y3, y1, jnp.float32)
+    _allclose(dw3, dw1, jnp.float32)
+
+
+def test_resolve_chunks_math():
+    assert OV.resolve_chunks(1, 64) == 1
+    assert OV.resolve_chunks(4, 64) == 4
+    assert OV.resolve_chunks(5, 10, site="t-a") == 5
+    OV._WARNED_SITES.clear()
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter("always")
+        assert OV.resolve_chunks(7, 8, site="t-b") == 4
+        assert OV.resolve_chunks(6, 9, site="t-c") == 3
+        assert OV.resolve_chunks(3, 7, site="t-d") == 1
+    assert len(rec) == 3
+
+
+def test_tuner_owned_chunks_consult_cache(monkeypatch):
+    """overlap_chunks=None asks tune.tuned('overlap_chunks', ...) with
+    the overlap_attrs key; a planted config drives the chunk count."""
+    from apex_tpu import tune
+    seen = {}
+    real = tune.tuned
+
+    def fake(op, attrs=None, **kw):
+        if op == "overlap_chunks":
+            seen[attrs["path"]] = dict(attrs)
+            return {"chunks": 2}
+        return real(op, attrs, **kw)
+
+    monkeypatch.setattr(tune, "tuned", fake)
+    mesh = _tp_mesh(4)
+    h, o = 16, 32
+    w = jnp.ones((h, o), jnp.float32)
+    x = jnp.ones((32, 2, h), jnp.float32)
+    lay = ColumnParallelLinear(h, o, bias=False, sequence_parallel=True,
+                               axis_name="tp", overlap_chunks=None)
+    hlo = jax.jit(shard_map(
+        lambda w_, x_: lay.apply({"weight": w_}, x_), mesh=mesh,
+        in_specs=(P(None, "tp"), P("tp")),
+        out_specs=P(None, None, "tp"),
+        check_vma=False)).lower(w, x).as_text()
+    assert "collective_permute" in hlo  # the planted chunks=2 ran
+    assert seen["tp_col"]["ax"] == 4
+    assert seen["tp_col"]["dtype"] == "float32"
+
+
+# ------------------------------- MoE ----------------------------------
+
+def test_moe_chunked_exchange_bitwise_rowwise():
+    """dispatch.chunked_expert_exchange with a row-independent ffn is
+    BITWISE the monolithic exchange at every chunk count (elementwise
+    ffn → identical per-row values, exact reassembly), through the
+    real ep=2 all_to_all pair."""
+    e, h, t = 4, 8, 16
+    M.destroy_model_parallel()
+    mesh = M.initialize_model_parallel(expert_model_parallel_size=2,
+                                       devices=jax.devices()[:4])
+
+    def f(xs, chunks):
+        idx = (jnp.arange(xs.shape[0])[:, None] * 3) % e
+        cap = R.expert_capacity(xs.shape[0], e, 1, float("inf"))
+        dest, _ = R.capacity_destinations(idx, e, cap)
+        buf = D.dispatch(xs, dest, e, cap)
+        ybuf = D.chunked_expert_exchange(
+            buf, lambda xe: xe * 2.0 + 1.0, "ep", 2, e, cap, chunks)
+        return D.combine(ybuf, dest, jnp.ones((xs.shape[0], 1),
+                                              jnp.float32))
+
+    x = jax.random.normal(jax.random.PRNGKey(4), (16, h), jnp.float32)
+    outs = [jax.jit(shard_map(
+        lambda xs, c=c: f(xs, c), mesh=mesh,
+        in_specs=(P(("dp", "ep")),), out_specs=P(("dp", "ep")),
+        check_vma=False))(x) for c in CHUNKS]
+    for c, out in zip(CHUNKS[1:], outs[1:]):
+        assert np.array_equal(np.asarray(out), np.asarray(outs[0])), c
+
+
+@pytest.mark.parametrize("dtype", DTYPES, ids=["fp32", "bf16"])
+def test_moe_micro_chunk_allclose(dtype):
+    """MoEMLP fwd + bwd at chunks {2, 4} vs the monolithic anchor on a
+    dp x ep=2 mesh: outputs and (pmean'd) param grads allclose."""
+    M.destroy_model_parallel()
+    mesh = M.initialize_model_parallel(expert_model_parallel_size=2,
+                                       devices=jax.devices()[:4])
+    hid, ffn, e = 16, 32, 4
+    tloc = 16  # local tokens; cap = ceil(16*2*2/4) = 16, 4-divisible
+    x = jax.random.normal(jax.random.PRNGKey(7), (2 * 2 * tloc, hid),
+                          jnp.float32).astype(dtype)
+    t = jax.random.normal(jax.random.PRNGKey(8), x.shape, jnp.float32)
+
+    def run(chunks):
+        moe = MoEMLP(hid, ffn, e, top_k=2, capacity_factor=2.0,
+                     ep_size=2, overlap_chunks=chunks)
+        params = moe.init(jax.random.PRNGKey(0), dtype)
+
+        def local(p, x_l, t_l):
+            def loss_fn(p_):
+                y, _aux = moe.apply(p_, x_l)
+                return jnp.sum(y.astype(jnp.float32)
+                               * t_l.astype(jnp.float32))
+            loss, grads = jax.value_and_grad(loss_fn)(p)
+            grads = jax.tree_util.tree_map(
+                lambda g: lax.pmean(g, ("dp", "ep")), grads)
+            y, _ = moe.apply(p, x_l)
+            return y, lax.psum(loss, ("dp", "ep")).reshape(1), grads
+
+        f = shard_map(local, mesh=mesh,
+                      in_specs=(P(), P(("dp", "ep")), P(("dp", "ep"))),
+                      out_specs=(P(("dp", "ep")), P(), P()),
+                      check_vma=False)
+        return jax.jit(f)(params, x, t)
+
+    y1, l1, g1 = run(1)
+    for c in (2, 4):
+        yc, lc, gc = run(c)
+        _allclose(yc, y1, dtype)
+        _allclose(lc, l1, dtype)
+        for k in g1:
+            _allclose(gc[k], g1[k], dtype)
+
+
+def test_moe_chunks1_byte_identical():
+    """MoEMLP at overlap_chunks=1 vs =None (tuner miss) lower to the
+    same HLO — the untuned-path anchor for the exchange."""
+    M.destroy_model_parallel()
+    mesh = M.initialize_model_parallel(expert_model_parallel_size=2,
+                                       devices=jax.devices()[:4])
+    hid, ffn, e = 16, 32, 4
+    x = jnp.ones((64, hid), jnp.float32)
+
+    def lowered(chunks):
+        moe = MoEMLP(hid, ffn, e, top_k=2, capacity_factor=2.0,
+                     ep_size=2, overlap_chunks=chunks)
+        params = moe.init(jax.random.PRNGKey(0), jnp.float32)
+        f = jax.jit(shard_map(
+            lambda p, x_l: moe.apply(p, x_l)[0], mesh=mesh,
+            in_specs=(P(), P(("dp", "ep"))),
+            out_specs=P(("dp", "ep")), check_vma=False))
+        return f.lower(params, x).as_text()
+
+    assert lowered(1) == lowered(None)
+
+
+def test_moe_chunked_all_to_all_inventory():
+    """chunks=2 doubles the all-to-all count at half the rows each —
+    chunk-count-many smaller collectives, same total payload (the
+    comms-fixture pin, unit-level)."""
+    M.destroy_model_parallel()
+    mesh = M.initialize_model_parallel(expert_model_parallel_size=2,
+                                       devices=jax.devices()[:4])
+    hid, ffn, e = 16, 32, 4
+    x = jnp.ones((64, hid), jnp.float32)
+
+    def count_a2a(chunks):
+        moe = MoEMLP(hid, ffn, e, top_k=2, capacity_factor=2.0,
+                     ep_size=2, overlap_chunks=chunks)
+        params = moe.init(jax.random.PRNGKey(0), jnp.float32)
+        hlo = jax.jit(shard_map(
+            lambda p, x_l: moe.apply(p, x_l)[0], mesh=mesh,
+            in_specs=(P(), P(("dp", "ep"))),
+            out_specs=P(("dp", "ep")), check_vma=False)
+        ).lower(params, x).as_text()
+        return hlo.count("stablehlo.all_to_all")
+
+    n1, n2 = count_a2a(1), count_a2a(2)
+    assert n1 > 0 and n2 == 2 * n1

@@ -371,6 +371,61 @@ def test_crosscheck_agreement_divergence_and_fallbacks():
     assert res2["ok"] is True and res2["n_unmeasured"] == 4
 
 
+def test_crosscheck_prefix_groups_beat_kind_ordinals_on_chunked():
+    """ISSUE 18 regression: a chunked program spells one logical
+    collective as chunk-count-many same-kind instructions
+    ("all-gather-start.{1,2}") next to an unrelated SYNC same-kind
+    collective.  When the trace renumbers instances (no exact-name
+    match), raw kind-ordinal pairing judges the first overlapped
+    chunk against the sync collective's 0%-overlap span — a spurious
+    DIVERGES on both rows.  Name-prefix pools (".N" stripped,
+    "-start" kept) keep chunk spans with their own logical
+    collective."""
+    comms = _comms_dict([
+        _cc("all-gather-start.1", "all-gather", overlap=0.9,
+            expected=True),
+        _cc("all-gather-start.2", "all-gather", overlap=0.9,
+            expected=True),
+        _cc("all-gather.9", "all-gather", overlap=0.0),
+    ])
+    # trace order puts the sync span FIRST — the ordinal trap
+    tl = _timeline_with([
+        _span("all-gather.3", "all-gather", 0.0),
+        _span("all-gather-start.4", "all-gather", 0.92),
+        _span("all-gather-start.5", "all-gather", 0.88),
+    ])
+    res = timeline.crosscheck_comms(tl, comms)
+    by = {r["name"]: r for r in res["rows"]}
+    assert by["all-gather-start.1"]["measured_overlap_fraction"] == 0.92
+    assert by["all-gather-start.2"]["measured_overlap_fraction"] == 0.88
+    assert by["all-gather.9"]["measured_overlap_fraction"] == 0.0
+    assert all(r["verdict"] == "AGREE" for r in res["rows"])
+    assert res["ok"] is True and res["n_diverge"] == 0
+
+
+def test_crosscheck_prefix_fallback_strips_start_spelling():
+    """A trace that records async ops under their BASE name still
+    pools with the comms side's "-start" spelling (the pass-1
+    tolerance, extended to renumbered instances)."""
+    comms = _comms_dict([
+        _cc("reduce-scatter-start.1", "reduce-scatter", overlap=0.8,
+            expected=True),
+        _cc("reduce-scatter-start.2", "reduce-scatter", overlap=0.8,
+            expected=True),
+    ])
+    tl = _timeline_with([
+        _span("reduce-scatter.6", "reduce-scatter", 0.85),
+        _span("reduce-scatter.7", "reduce-scatter", 0.75),
+    ])
+    res = timeline.crosscheck_comms(tl, comms)
+    by = {r["name"]: r for r in res["rows"]}
+    assert by["reduce-scatter-start.1"]["measured_overlap_fraction"] \
+        == 0.85
+    assert by["reduce-scatter-start.2"]["measured_overlap_fraction"] \
+        == 0.75
+    assert all(r["verdict"] == "AGREE" for r in res["rows"])
+
+
 # ------------------------------ v11 schema ------------------------------
 
 def _base_record():
@@ -487,7 +542,8 @@ def test_timeline_probe_flagship_cli():
     step count matches the window, fractions sum to ~1, schema
     round-trips), overlap honestly UNMEASURABLE, and crosscheck_comms
     rows cover every counted collective of the dp ZeRO-2 step."""
-    r = _run_script(ROOT / "scripts" / "timeline_probe.py", "--json")
+    r = _run_script(ROOT / "scripts" / "timeline_probe.py", "--json",
+                    "gpt", "gpt_zero2")
     assert r.returncode == 0, r.stdout[-3000:] + r.stderr[-3000:]
     reports = [json.loads(l) for l in r.stdout.splitlines()
                if l.startswith("{")]
@@ -510,6 +566,29 @@ def test_timeline_probe_flagship_cli():
     kinds = [r["kind"] for r in xc["rows"]]
     assert kinds.count("reduce-scatter") >= 4
     assert all(r["verdict"] == "UNMEASURED" for r in xc["rows"])
+
+
+@pytest.mark.slow
+def test_timeline_probe_tp_overlap_target():
+    """ISSUE 18 acceptance: the measured probe passes on the
+    chunked-TP flagship — structure asserts green, overlap honestly
+    UNMEASURABLE on CPU while the crosscheck carries a row for every
+    counted collective, the chunk-count-many ring ppermutes
+    included."""
+    r = _run_script(ROOT / "scripts" / "timeline_probe.py", "--json",
+                    "gpt_tp_overlap")
+    assert r.returncode == 0, r.stdout[-3000:] + r.stderr[-3000:]
+    reports = [json.loads(l) for l in r.stdout.splitlines()
+               if l.startswith("{")]
+    x = next(x for x in reports if x["target"] == "gpt_tp_overlap")
+    assert x["ok"]
+    assert x["report"]["overlap_measurable"] is False  # CPU: honest
+    xc = x["crosscheck"]
+    assert xc is not None and xc["ok"]
+    kinds = [row["kind"] for row in xc["rows"]]
+    # 2 rings x 2L col sites x (p-1) hops x chunks on the smoke config
+    assert kinds.count("collective-permute") == 16
+    assert all(row["verdict"] == "UNMEASURED" for row in xc["rows"])
 
 
 def test_train_with_monitor_profile_steps(tmp_path):
